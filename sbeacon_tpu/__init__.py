@@ -1,0 +1,19 @@
+"""sbeacon_tpu — TPU-native GA4GH Beacon v2 framework.
+
+A ground-up rebuild of the capabilities of CSIRO's serverless Beacon
+(reference: terraform-aws-serverless-beacon) designed for TPU hardware:
+
+- VCF ingestion (BGZF/CSI/TBI machinery, C++ hot path) into an HBM-resident
+  columnar variant index (sorted (contig,pos) keys, packed alleles, AC/AN).
+- Batched Beacon region queries answered by a jit/vmap'd sorted-interval
+  search kernel instead of per-region ``bcftools`` subprocess scans
+  (reference: lambda/performQuery/search_variants.py).
+- Dataset-sharded execution over a ``jax.sharding.Mesh`` with psum/all_gather
+  fan-in replacing the SNS + DynamoDB-atomic-counter fan-out/fan-in
+  (reference: shared_resources/variantutils/search_variants.py).
+- A host-side metadata engine (sqlite) playing the Athena/Glue role, with the
+  Beacon filtering-terms compiler and ontology term-closure store.
+- The full Beacon v2 REST surface served by a stdlib HTTP server.
+"""
+
+__version__ = "0.1.0"

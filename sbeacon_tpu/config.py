@@ -1,0 +1,150 @@
+"""Typed configuration for the whole framework.
+
+The reference spreads configuration over three tiers — terraform variables,
+per-lambda environment variables assembled from shared locals, and in-code
+constants (reference: variables.tf:1-54, main.tf:24-63, splitQuery
+SPLIT_SIZE=10000, variantutils THREADS=500, main.tf:16-17 data ceilings).
+Here the same three semantic groups live in one typed config object; env vars
+can still override (``BeaconConfig.from_env``) so deployments keep the same
+knob surface.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from pathlib import Path
+
+
+@dataclasses.dataclass(frozen=True)
+class BeaconInfo:
+    """Beacon identity — reference: variables.tf + getInfo env block."""
+
+    beacon_id: str = "org.tpu.beacon"
+    beacon_name: str = "TPU Native Beacon"
+    api_version: str = "v2.0.0"
+    environment: str = "dev"
+    description: str = "TPU-native GA4GH Beacon v2 implementation"
+    version: str = "v2.0"
+    welcome_url: str = ""
+    alternative_url: str = ""
+    org_id: str = "TPU"
+    org_name: str = "TPU Beacon"
+    org_description: str = ""
+    org_address: str = ""
+    org_welcome_url: str = ""
+    org_contact_url: str = ""
+    org_logo_url: str = ""
+    default_granularity: str = "boolean"
+    uri: str = "http://localhost:5000"
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageConfig:
+    """On-disk layout re-homing the reference's S3/DynamoDB/Athena stores.
+
+    Every stateful contract in the reference maps to an explicit local path
+    (SURVEY.md section 2.4): the variants bucket's ``vcf-summaries/`` index
+    prefix -> ``index_dir``; the metadata bucket's ORC tables + Athena
+    database -> ``metadata_db`` (sqlite); the DynamoDB control tables
+    (Datasets, VcfSummaries, VariantQueries, ...) -> ``ledger_db`` (sqlite);
+    ontology tables (Ontologies/Anscestors/Descendants/OntoIndex) ->
+    ``ontology_db``.
+    """
+
+    root: Path = Path("./beacon_data")
+
+    @property
+    def index_dir(self) -> Path:
+        return self.root / "variant-index"
+
+    @property
+    def metadata_db(self) -> Path:
+        return self.root / "metadata.sqlite"
+
+    @property
+    def ledger_db(self) -> Path:
+        return self.root / "ledger.sqlite"
+
+    @property
+    def ontology_db(self) -> Path:
+        return self.root / "ontology.sqlite"
+
+    @property
+    def query_results_dir(self) -> Path:
+        """Async query result spill (reference: variant-queries/ S3 prefix)."""
+        return self.root / "query-results"
+
+    def ensure(self) -> "StorageConfig":
+        for p in (self.root, self.index_dir, self.query_results_dir):
+            p.mkdir(parents=True, exist_ok=True)
+        return self
+
+
+@dataclasses.dataclass(frozen=True)
+class EngineConfig:
+    """Query/ingest engine tuning — the reference's in-code constants tier.
+
+    window_cap: max candidate rows gathered per query around the searchsorted
+      hit range (replaces the reference's 10kb-window x unbounded-scan shape,
+      splitQuery SPLIT_SIZE=10000, with a fixed-shape gather the XLA compiler
+      can tile).
+    record_cap: max matched rows returned per query for record granularity
+      (two-pass host fallback on overflow).
+    ingest_shard_bytes: target uncompressed bytes per ingest slice
+      (reference: summariseVcf cost-model; ABS_MAX_DATA_SPLIT 750MB,
+      main.tf:16).
+    max_index_rows_per_shard: device-side padding unit for index shards.
+    """
+
+    window_cap: int = 2048
+    record_cap: int = 1024
+    batch_size: int = 1024
+    ingest_shard_bytes: int = 64 * 1024 * 1024
+    ingest_workers: int = 8
+    max_response_inline_bytes: int = 300 * 1024  # performQuery spill threshold
+    request_timeout_s: float = 600.0  # variantutils REQUEST_TIMEOUT
+    mesh_axis: str = "d"
+    use_tpu: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class BeaconConfig:
+    info: BeaconInfo = dataclasses.field(default_factory=BeaconInfo)
+    storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
+    engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+
+    @staticmethod
+    def from_env(root: str | os.PathLike | None = None) -> "BeaconConfig":
+        """Build config with env-var overrides (reference env-var tier)."""
+        env = os.environ
+        info = BeaconInfo(
+            beacon_id=env.get("BEACON_ID", BeaconInfo.beacon_id),
+            beacon_name=env.get("BEACON_NAME", BeaconInfo.beacon_name),
+            api_version=env.get("BEACON_API_VERSION", BeaconInfo.api_version),
+            environment=env.get("BEACON_ENVIRONMENT", BeaconInfo.environment),
+            uri=env.get("BEACON_URL", BeaconInfo.uri),
+        )
+        storage = StorageConfig(
+            root=Path(root or env.get("BEACON_DATA_ROOT", "./beacon_data"))
+        )
+        eng_over = {}
+        if "BEACON_WINDOW_CAP" in env:
+            eng_over["window_cap"] = int(env["BEACON_WINDOW_CAP"])
+        if "BEACON_RECORD_CAP" in env:
+            eng_over["record_cap"] = int(env["BEACON_RECORD_CAP"])
+        if "BEACON_USE_TPU" in env:
+            eng_over["use_tpu"] = env["BEACON_USE_TPU"].lower() not in (
+                "0",
+                "false",
+                "no",
+                "off",
+            )
+        engine = EngineConfig(**eng_over)
+        return BeaconConfig(info=info, storage=storage, engine=engine)
+
+    def dumps(self) -> str:
+        d = dataclasses.asdict(self)
+        d["storage"]["root"] = str(d["storage"]["root"])
+        return json.dumps(d, indent=2)

@@ -1,0 +1,111 @@
+"""Synthetic data generation for tests, benchmarks and simulations.
+
+Plays the role of the reference's simulation generator (reference:
+simulations/simulate.py — synthetic populations from a template VCF), but
+generates structured-random VCF records directly, covering every branch of
+the variant-matching semantics: SNPs, indels, multi-alt records, symbolic
+alleles (<DEL>, <DUP>, <CN0>...), records with and without INFO AC/AN, and
+genotype columns.
+"""
+
+from __future__ import annotations
+
+import random
+from pathlib import Path
+
+from .genomics.vcf import VcfRecord, write_vcf
+
+BASES = "ACGT"
+
+SYMBOLIC_ALTS = [
+    "<DEL>",
+    "<INS>",
+    "<DUP>",
+    "<DUP:TANDEM>",
+    "<CN0>",
+    "<CN1>",
+    "<CN2>",
+    "<CN3>",
+    "<INV>",
+]
+
+
+def _random_seq(rng: random.Random, lo: int, hi: int) -> str:
+    return "".join(rng.choice(BASES) for _ in range(rng.randint(lo, hi)))
+
+
+def random_records(
+    rng: random.Random,
+    chrom: str = "1",
+    n: int = 500,
+    start: int = 1000,
+    spacing: int = 30,
+    n_samples: int = 8,
+    p_multiallelic: float = 0.15,
+    p_symbolic: float = 0.08,
+    p_no_acan: float = 0.2,
+    p_indel: float = 0.2,
+) -> list[VcfRecord]:
+    """Generate sorted synthetic records exercising all matcher branches."""
+    records = []
+    pos = start
+    for _ in range(n):
+        pos += rng.randint(1, spacing)
+        ref = _random_seq(rng, 1, 1) if rng.random() > p_indel else _random_seq(rng, 1, 6)
+        n_alts = 2 if rng.random() < p_multiallelic else 1
+        alts = []
+        for _ in range(n_alts):
+            r = rng.random()
+            if r < p_symbolic:
+                alts.append(rng.choice(SYMBOLIC_ALTS))
+            elif r < p_symbolic + 0.1 and len(ref) <= 3:
+                # duplication-shaped alt: ref repeated k times
+                alts.append(ref * rng.randint(2, 3))
+            else:
+                alt = _random_seq(rng, 1, 6)
+                while alt == ref:
+                    alt = _random_seq(rng, 1, 6)
+                alts.append(alt)
+        # genotypes: diploid calls over alleles 0..n_alts
+        genotypes = []
+        for _ in range(n_samples):
+            a = rng.randint(0, n_alts)
+            b = rng.randint(0, n_alts)
+            sep = rng.choice("|/")
+            genotypes.append(f"{a}{sep}{b}")
+        vt = rng.choice(["SNP", "INDEL", "SV", "N/A"])
+        rec = VcfRecord(
+            chrom=chrom,
+            pos=pos,
+            ref=ref,
+            alts=alts,
+            ac=None,
+            an=None,
+            vt=vt,
+            genotypes=genotypes,
+        )
+        if rng.random() >= p_no_acan:
+            # derive INFO AC/AN through the one shared implementation
+            rec.ac = rec.effective_ac()
+            rec.an = rec.effective_an()
+        records.append(rec)
+    return records
+
+
+def make_test_vcf(
+    path: str | Path,
+    seed: int = 0,
+    chroms: tuple[str, ...] = ("1",),
+    n_per_chrom: int = 500,
+    n_samples: int = 8,
+    **kw,
+) -> list[VcfRecord]:
+    """Write a synthetic bgzipped VCF; returns its records."""
+    rng = random.Random(seed)
+    records: list[VcfRecord] = []
+    for chrom in chroms:
+        records.extend(
+            random_records(rng, chrom=chrom, n=n_per_chrom, n_samples=n_samples, **kw)
+        )
+    write_vcf(path, records, sample_names=[f"S{i:04d}" for i in range(n_samples)])
+    return records

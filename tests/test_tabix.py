@@ -1,0 +1,82 @@
+from sbeacon_tpu.genomics import bgzf
+from sbeacon_tpu.genomics.tabix import build_tbi, list_chromosomes
+from sbeacon_tpu.genomics.vcf import iter_vcf_records
+from sbeacon_tpu.testing import make_test_vcf
+
+
+def test_list_chromosomes_without_index(tmp_path):
+    p = tmp_path / "t.vcf.gz"
+    make_test_vcf(p, seed=1, chroms=("1", "2", "X"), n_per_chrom=50)
+    assert list_chromosomes(p) == ["1", "2", "X"]
+
+
+def test_build_tbi_linear_index(tmp_path):
+    p = tmp_path / "t.vcf.gz"
+    recs = make_test_vcf(p, seed=2, chroms=("1", "2"), n_per_chrom=2000, spacing=200)
+    idx = build_tbi(p)
+    assert idx.names == ["1", "2"]
+    # chunks_for_region should point at or before the first record >= beg
+    chrom1 = [r for r in recs if r.chrom == "1"]
+    target = chrom1[len(chrom1) // 2]
+    chunks = idx.chunks_for_region("1", target.pos - 1, target.pos)
+    assert chunks, "no chunks for mid-file region"
+    reader = bgzf.BgzfReader(p)
+    found = []
+    for _, line in reader.iter_lines(chunks[0].beg):
+        if line.startswith(b"#"):
+            continue
+        fields = line.split(b"\t", 2)
+        if fields[0] != b"1":
+            break
+        found.append(int(fields[1]))
+        if int(fields[1]) > target.pos:
+            break
+    assert target.pos in found
+    # records before the linear-index window should not force a full scan:
+    # the chunk must start at/after the start of the file's chrom-1 body
+    first_voff = idx.first_voffset("1")
+    assert chunks[0].beg >= first_voff
+
+
+def test_region_iteration_matches_full_scan(tmp_path):
+    p = tmp_path / "t.vcf.gz"
+    recs = make_test_vcf(p, seed=4, chroms=("1",), n_per_chrom=1000)
+    lo = recs[200].pos
+    hi = recs[400].pos
+    got = [r.pos for r in iter_vcf_records(p, region=("1", lo, hi))]
+    want = [r.pos for r in recs if not (r.pos + len(r.ref) - 1 < lo or r.pos > hi)]
+    assert got == want
+
+
+def test_cross_block_chunk_end(tmp_path):
+    # regression: chunk end voffset must come from line voffsets, not
+    # byte-length arithmetic (invalid when lines cross BGZF blocks)
+    from sbeacon_tpu.genomics.vcf import VcfRecord, write_vcf, iter_vcf_records
+
+    p = tmp_path / "big.vcf.gz"
+    recs = [
+        VcfRecord("1", 100 + i * 10, "A" * 200, ["G" * 200], [1], 4, "SNP", ["0|1"] * 40)
+        for i in range(500)
+    ]
+    write_vcf(p, recs)
+    idx = build_tbi(p)
+    lo, hi = recs[-3].pos, recs[-1].pos
+    got = [r.pos for r in iter_vcf_records(p, region=("1", lo, hi), index=idx)]
+    want = [r.pos for r in recs if r.pos + 199 >= lo and r.pos <= hi]
+    assert got == want
+    assert got[-1] == recs[-1].pos  # final record of the file is not dropped
+
+
+def test_unsorted_contigs_rejected(tmp_path):
+    from sbeacon_tpu.genomics.vcf import VcfRecord, write_vcf
+    import pytest
+
+    p = tmp_path / "bad.vcf.gz"
+    recs = [
+        VcfRecord("1", 100, "A", ["G"], [1], 2, "SNP", ["0|1"]),
+        VcfRecord("2", 100, "A", ["G"], [1], 2, "SNP", ["0|1"]),
+        VcfRecord("1", 200, "A", ["G"], [1], 2, "SNP", ["0|1"]),
+    ]
+    write_vcf(p, recs, contigs=["1", "2"])
+    with pytest.raises(ValueError, match="out of order"):
+        build_tbi(p)

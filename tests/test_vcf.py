@@ -1,0 +1,59 @@
+from sbeacon_tpu.genomics.vcf import (
+    VcfRecord,
+    iter_vcf_records,
+    parse_info,
+    parse_record,
+    read_sample_names,
+    write_vcf,
+)
+from sbeacon_tpu.testing import make_test_vcf
+
+
+def test_parse_info():
+    assert parse_info("AC=3,4;AN=10;VT=SNP") == ([3, 4], 10, "SNP")
+    assert parse_info("DP=4") == (None, None, "N/A")
+    assert parse_info(".") == (None, None, "N/A")
+
+
+def test_parse_record_with_genotypes():
+    line = "1\t123\t.\tA\tG,T\t.\tPASS\tAC=1,2;AN=6\tGT:DP\t0|1:3\t2/2:5\t.:1"
+    rec = parse_record(line)
+    assert rec.chrom == "1" and rec.pos == 123
+    assert rec.alts == ["G", "T"]
+    assert rec.ac == [1, 2] and rec.an == 6
+    assert rec.genotypes == ["0|1", "2/2", "."]
+    assert rec.genotype_calls() == [0, 1, 2, 2]
+
+
+def test_effective_counts_fallback():
+    rec = parse_record("1\t5\t.\tA\tG\t.\t.\t.\tGT\t0|1\t1|1\t.|.")
+    assert rec.ac is None
+    assert rec.effective_ac() == [3]
+    assert rec.effective_an() == 4  # '.' haplotypes contribute no calls
+
+
+def test_vcf_roundtrip(tmp_path):
+    p = tmp_path / "t.vcf.gz"
+    recs = make_test_vcf(p, seed=3, n_per_chrom=200, n_samples=4)
+    out = list(iter_vcf_records(p))
+    assert len(out) == len(recs)
+    for a, b in zip(recs, out):
+        assert (a.chrom, a.pos, a.ref, a.alts) == (b.chrom, b.pos, b.ref, b.alts)
+        assert a.ac == b.ac and a.an == b.an
+        assert a.genotypes == b.genotypes
+    assert read_sample_names(p) == ["S0000", "S0001", "S0002", "S0003"]
+
+
+def test_region_filter(tmp_path):
+    p = tmp_path / "t.vcf.gz"
+    recs = [
+        VcfRecord("1", 100, "ACGT", ["A"], [1], 4, "INDEL", ["0|1", "0|0"]),
+        VcfRecord("1", 200, "A", ["G"], [1], 4, "SNP", ["0|1", "0|0"]),
+        VcfRecord("2", 150, "A", ["G"], [1], 4, "SNP", ["0|1", "0|0"]),
+    ]
+    write_vcf(p, recs)
+    # REF-span overlap semantics: record at 100 spans 100-103
+    hits = list(iter_vcf_records(p, region=("1", 103, 250)))
+    assert [(r.chrom, r.pos) for r in hits] == [("1", 100), ("1", 200)]
+    hits = list(iter_vcf_records(p, region=("1", 104, 250)))
+    assert [(r.chrom, r.pos) for r in hits] == [("1", 200)]
