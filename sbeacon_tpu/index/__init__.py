@@ -1,0 +1,19 @@
+from .columnar import (
+    FLAG,
+    VariantIndexShard,
+    build_index,
+    fnv1a32,
+    load_index,
+    merge_shards,
+    save_index,
+)
+
+__all__ = [
+    "FLAG",
+    "VariantIndexShard",
+    "build_index",
+    "fnv1a32",
+    "load_index",
+    "merge_shards",
+    "save_index",
+]

@@ -1,0 +1,521 @@
+"""HBM-resident columnar variant index.
+
+This is the TPU-native replacement for the reference's on-S3 binary variant
+index (reference: lambda/summariseSlice/source/write_data_to_s3.h —
+(pos:u64, len:u16, "ref_alt") records with 4-bit packed bases, sharded into
+region files). That format exists to be re-scanned by more lambdas; ours
+exists to be *queried on-device*, so the layout is struct-of-arrays with one
+row per (record, alt) pair, sorted by (chrom_code, pos), every
+variable-length/regex-ish predicate of the matcher pre-computed into
+fixed-width columns at ingest:
+
+- allele identity: fnv1a32 hash of uppercased sequence + length (exact
+  compare on device), 16 raw prefix bytes (symbolic-allele prefix matching),
+- symbolic-allele structure: flag bits for '<', '<CN', literal '<CN0>'/
+  '<CN1>'/'<CN2>', '<DEL'/'<DUP' prefixes, '.' and single-base alts,
+- duplication structure: ref_repeat_k (alt == ref*k) covering the
+  reference's DUP/DUP:TANDEM/CNV regexes (performQuery/search_variants.py:
+  124-158) without any per-query string work,
+- counts: AC materialised per alt and AN per record (INFO values when
+  present, genotype-derived otherwise — the AC/AN-vs-genotype duality of
+  performQuery :205-226 collapses at ingest),
+- genotype bitsets per row (sample hit extraction, selected-samples path).
+
+Host-only blobs keep the original REF/ALT bytes for materialising Beacon
+variant strings from matched row ids.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+from ..genomics.vcf import VcfRecord
+from ..utils.chrom import chromosome_code
+
+N_CHROM_CODES = 26  # codes 1..25 valid; offsets array has 27 entries
+
+INT32_MAX = np.int32(2**31 - 1)
+
+
+class FLAG:
+    SYMBOLIC = 1  # alt starts with '<'
+    CN_PREFIX = 2  # alt starts with '<CN'
+    CN0 = 4  # alt == '<CN0>'
+    CN1 = 8  # alt == '<CN1>'
+    CN2 = 16  # alt == '<CN2>'
+    DOT = 32  # alt == '.'
+    DEL_PREFIX = 64  # alt starts with '<DEL'
+    DUP_PREFIX = 128  # alt starts with '<DUP'
+    SINGLE_BASE = 256  # alt.upper() in {A,C,G,T,N}
+
+
+def fnv1a32(data: bytes) -> int:
+    """FNV-1a 32-bit, returned as int32 bit pattern."""
+    h = 0x811C9DC5
+    for b in data:
+        h ^= b
+        h = (h * 0x01000193) & 0xFFFFFFFF
+    return int(np.uint32(h).view(np.int32))
+
+
+def pack_prefix16(data: bytes) -> np.ndarray:
+    """First 16 bytes as 4 big-endian uint32 words (zero padded)."""
+    buf = data[:16].ljust(16, b"\x00")
+    return np.frombuffer(buf, dtype=">u4").astype(np.uint32)
+
+
+def prefix_mask(length: int) -> np.ndarray:
+    """uint32[4] mask selecting the first ``length`` bytes of a prefix16."""
+    out = np.zeros(4, dtype=np.uint32)
+    for w in range(4):
+        covered = max(0, min(4, length - 4 * w))
+        if covered == 4:
+            out[w] = 0xFFFFFFFF
+        elif covered > 0:
+            out[w] = np.uint32(0xFFFFFFFF) << np.uint32(8 * (4 - covered))
+    return out
+
+
+def _ref_repeat_k(ref: str, alt: str) -> int:
+    """k such that alt == ref * k (k >= 1), else -1. Covers the DUP
+    '(ref){2,}' / DUP:TANDEM 'ref+ref' / CNV '(ref)*' regex family."""
+    lr, la = len(ref), len(alt)
+    if lr == 0 or la == 0 or la % lr != 0:
+        return -1
+    k = la // lr
+    if alt == ref * k:
+        return min(k, 120)
+    return -1
+
+
+def _alt_flags(alt: str) -> int:
+    f = 0
+    if alt.startswith("<"):
+        f |= FLAG.SYMBOLIC
+        if alt.startswith("<CN"):
+            f |= FLAG.CN_PREFIX
+        if alt == "<CN0>":
+            f |= FLAG.CN0
+        elif alt == "<CN1>":
+            f |= FLAG.CN1
+        elif alt == "<CN2>":
+            f |= FLAG.CN2
+        if alt.startswith("<DEL"):
+            f |= FLAG.DEL_PREFIX
+        if alt.startswith("<DUP"):
+            f |= FLAG.DUP_PREFIX
+    else:
+        if alt == ".":
+            f |= FLAG.DOT
+        if len(alt) == 1 and alt.upper() in "ACGTN":
+            f |= FLAG.SINGLE_BASE
+    return f
+
+
+# Device-bound columns: name -> dtype
+DEVICE_COLUMNS = {
+    "pos": np.int32,
+    "rec_end": np.int32,  # pos + ref_len - 1
+    "ref_len": np.int32,
+    "alt_len": np.int32,
+    "ref_hash": np.int32,  # fnv1a32(ref.upper())
+    "alt_hash": np.int32,  # fnv1a32(alt.upper())
+    "ref_repeat_k": np.int32,
+    "flags": np.int32,
+    "ac": np.int32,
+    "an": np.int32,
+    "rec_id": np.int32,
+}
+
+
+@dataclass
+class VariantIndexShard:
+    """One dataset+VCF's worth of index rows (a shard of the global index)."""
+
+    meta: dict
+    cols: dict[str, np.ndarray]  # DEVICE_COLUMNS + alt_prefix uint32[n,4]
+    chrom_offsets: np.ndarray  # int32[27]: row span per chrom code
+    # host-only materialisation data
+    ref_blob: np.ndarray  # uint8
+    ref_off: np.ndarray  # uint32[n+1]
+    alt_blob: np.ndarray
+    alt_off: np.ndarray
+    vt_codes: np.ndarray  # int16[n] into meta['vt_vocab']
+    gt_bits: np.ndarray | None = None  # uint32[n, ceil(n_samples/32)]
+
+    @property
+    def n_rows(self) -> int:
+        return len(self.cols["pos"])
+
+    def row_ref(self, i: int) -> str:
+        return bytes(
+            self.ref_blob[self.ref_off[i] : self.ref_off[i + 1]]
+        ).decode()
+
+    def row_alt(self, i: int) -> str:
+        return bytes(
+            self.alt_blob[self.alt_off[i] : self.alt_off[i + 1]]
+        ).decode()
+
+    def row_vt(self, i: int) -> str:
+        return self.meta["vt_vocab"][self.vt_codes[i]]
+
+    def row_chrom(self, i: int) -> str:
+        # recover canonical chromosome from the offsets table
+        code = int(np.searchsorted(self.chrom_offsets, i, side="right")) - 1
+        from ..utils.chrom import CODE_TO_CHROMOSOME
+
+        return CODE_TO_CHROMOSOME.get(code, "?")
+
+    def row_samples(self, i: int) -> list[int]:
+        if self.gt_bits is None:
+            return []
+        bits = self.gt_bits[i]
+        out = []
+        for w, word in enumerate(bits):
+            word = int(word)
+            while word:
+                b = (word & -word).bit_length() - 1
+                out.append(w * 32 + b)
+                word &= word - 1
+        return out
+
+    def variant_string(self, i: int, chrom_label: str | None = None) -> str:
+        """'{chrom}\\t{pos}\\t{ref}\\t{alt}\\t{vt}' — the wire form the
+        route aggregation layer consumes (reference route_g_variants.py:163).
+        """
+        chrom = chrom_label if chrom_label is not None else self.row_chrom(i)
+        return (
+            f"{chrom}\t{self.cols['pos'][i]}\t{self.row_ref(i)}"
+            f"\t{self.row_alt(i)}\t{self.row_vt(i)}"
+        )
+
+
+def build_index(
+    records,
+    *,
+    dataset_id: str = "",
+    vcf_location: str = "",
+    sample_names: list[str] | None = None,
+    with_genotypes: bool = True,
+) -> VariantIndexShard:
+    """Explode VcfRecords into sorted columnar rows.
+
+    Records may arrive in any chromosome order (rows are stably re-sorted by
+    (chrom_code, pos) so per-record row groups stay contiguous); unknown
+    contigs are dropped (they are unreachable through Beacon's canonical
+    referenceName anyway — reference chrom_matching returns None for them).
+    """
+    sample_names = sample_names or []
+    n_samples = len(sample_names)
+    gt_words = (n_samples + 31) // 32 if n_samples else 0
+
+    rows: list[tuple] = []  # (chrom_code, pos, rec_ord, alt_ord, record)
+    vt_vocab: list[str] = ["N/A"]
+    vt_index = {"N/A": 0}
+    records = list(records)
+    dropped = 0
+    chrom_native: dict[str, str] = {}  # canonical -> native spelling in file
+    for rec_ord, rec in enumerate(records):
+        code = chromosome_code(rec.chrom)
+        if code == 0:
+            dropped += 1
+            continue
+        from ..utils.chrom import normalize_chromosome
+
+        canon = normalize_chromosome(rec.chrom)
+        chrom_native.setdefault(canon, rec.chrom)
+        for alt_ord in range(len(rec.alts)):
+            rows.append((code, rec.pos, rec_ord, alt_ord, rec))
+
+    # stable sort keeps a record's alts adjacent and in file order
+    rows.sort(key=lambda r: (r[0], r[1], r[2], r[3]))
+
+    n = len(rows)
+    cols = {name: np.zeros(n, dtype=dt) for name, dt in DEVICE_COLUMNS.items()}
+    alt_prefix = np.zeros((n, 4), dtype=np.uint32)
+    vt_codes = np.zeros(n, dtype=np.int16)
+    gt_bits = (
+        np.zeros((n, gt_words), dtype=np.uint32) if gt_words else None
+    )
+    ref_parts: list[bytes] = []
+    alt_parts: list[bytes] = []
+    chrom_offsets = np.zeros(N_CHROM_CODES + 1, dtype=np.int32)
+
+    # rec_id must be nondecreasing in row order for the windowed
+    # first-match-per-record scan on device; re-number by first appearance.
+    rec_renumber: dict[int, int] = {}
+    # cache per-record derived values
+    an_cache: dict[int, int] = {}
+    ac_cache: dict[int, list[int]] = {}
+    calls_cache: dict[int, list[int]] = {}
+
+    for i, (code, pos, rec_ord, alt_ord, rec) in enumerate(rows):
+        alt = rec.alts[alt_ord]
+        ref = rec.ref
+        if rec_ord not in rec_renumber:
+            rec_renumber[rec_ord] = len(rec_renumber)
+            ac_cache[rec_ord] = rec.effective_ac()
+            an_cache[rec_ord] = rec.effective_an()
+        cols["pos"][i] = pos
+        cols["rec_end"][i] = pos + len(ref) - 1
+        cols["ref_len"][i] = len(ref)
+        cols["alt_len"][i] = len(alt)
+        cols["ref_hash"][i] = fnv1a32(ref.upper().encode())
+        cols["alt_hash"][i] = fnv1a32(alt.upper().encode())
+        cols["ref_repeat_k"][i] = _ref_repeat_k(ref, alt)
+        cols["flags"][i] = _alt_flags(alt)
+        cols["ac"][i] = ac_cache[rec_ord][alt_ord]
+        cols["an"][i] = an_cache[rec_ord]
+        cols["rec_id"][i] = rec_renumber[rec_ord]
+        alt_prefix[i] = pack_prefix16(alt.encode())
+        if rec.vt not in vt_index:
+            vt_index[rec.vt] = len(vt_vocab)
+            vt_vocab.append(rec.vt)
+        vt_codes[i] = vt_index[rec.vt]
+        ref_parts.append(ref.encode())
+        alt_parts.append(alt.encode())
+        if gt_bits is not None and rec.genotypes:
+            if rec_ord not in calls_cache:
+                calls_cache[rec_ord] = [
+                    [int(t) for t in _split_gt(gt)] for gt in rec.genotypes
+                ]
+            allele = alt_ord + 1
+            for s_idx, toks in enumerate(calls_cache[rec_ord]):
+                if allele in toks:
+                    gt_bits[i, s_idx // 32] |= np.uint32(1 << (s_idx % 32))
+
+    # chrom offsets: chrom_offsets[c] = first row of code c
+    codes = np.array([r[0] for r in rows], dtype=np.int32)
+    for c in range(N_CHROM_CODES + 1):
+        chrom_offsets[c] = np.searchsorted(codes, c, side="left")
+
+    ref_off = np.zeros(n + 1, dtype=np.uint32)
+    alt_off = np.zeros(n + 1, dtype=np.uint32)
+    np.cumsum([len(p) for p in ref_parts], out=ref_off[1:] if n else None)
+    np.cumsum([len(p) for p in alt_parts], out=alt_off[1:] if n else None)
+
+    n_records = len(rec_renumber)
+    meta = {
+        "dataset_id": dataset_id,
+        "vcf_location": vcf_location,
+        "sample_names": sample_names,
+        "vt_vocab": vt_vocab,
+        "n_rows": n,
+        "n_records": n_records,
+        "dropped_records": dropped,
+        # dataset summary stats (reference summariseSlice counts:
+        # variantCount = #alts, callCount = sum AN, sampleCount)
+        "variant_count": n,
+        "call_count": int(
+            sum(an_cache[r] for r in rec_renumber)
+        ),
+        "sample_count": n_samples,
+        "chrom_native": chrom_native,
+        "format_version": 1,
+    }
+    shard = VariantIndexShard(
+        meta=meta,
+        cols={**cols, "alt_prefix": alt_prefix},
+        chrom_offsets=chrom_offsets,
+        ref_blob=np.frombuffer(b"".join(ref_parts), dtype=np.uint8).copy(),
+        ref_off=ref_off,
+        alt_blob=np.frombuffer(b"".join(alt_parts), dtype=np.uint8).copy(),
+        alt_off=alt_off,
+        vt_codes=vt_codes,
+        gt_bits=gt_bits,
+    )
+    return shard
+
+
+def _split_gt(gt: str) -> list[str]:
+    import re
+
+    return [t for t in re.split(r"[|/]", gt) if t.isdigit()]
+
+
+def save_index(shard: VariantIndexShard, path: str | Path) -> None:
+    """Persist a shard as one compressed npz + json meta sidecar."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arrays = {f"col_{k}": v for k, v in shard.cols.items()}
+    arrays["chrom_offsets"] = shard.chrom_offsets
+    arrays["ref_blob"] = shard.ref_blob
+    arrays["ref_off"] = shard.ref_off
+    arrays["alt_blob"] = shard.alt_blob
+    arrays["alt_off"] = shard.alt_off
+    arrays["vt_codes"] = shard.vt_codes
+    if shard.gt_bits is not None:
+        arrays["gt_bits"] = shard.gt_bits
+    np.savez_compressed(path, **arrays)
+    Path(str(path) + ".meta.json").write_text(json.dumps(shard.meta))
+
+
+def load_index(path: str | Path) -> VariantIndexShard:
+    path = Path(path)
+    data = np.load(path if path.suffix == ".npz" else str(path) + ".npz")
+    meta = json.loads(Path(str(path) + ".meta.json").read_text())
+    cols = {
+        k[4:]: data[k] for k in data.files if k.startswith("col_")
+    }
+    return VariantIndexShard(
+        meta=meta,
+        cols=cols,
+        chrom_offsets=data["chrom_offsets"],
+        ref_blob=data["ref_blob"],
+        ref_off=data["ref_off"],
+        alt_blob=data["alt_blob"],
+        alt_off=data["alt_off"],
+        vt_codes=data["vt_codes"],
+        gt_bits=data["gt_bits"] if "gt_bits" in data.files else None,
+    )
+
+
+def merge_shards(shards: list[VariantIndexShard]) -> VariantIndexShard:
+    """Merge per-VCF shards into one globally sorted shard (vectorised).
+
+    Used when a dataset has multiple VCFs pinned to the same device, and by
+    the distinct-variant counter. Genotype bitsets are dropped if sample
+    universes differ.
+    """
+    if len(shards) == 1:
+        return shards[0]
+
+    # per-shard chrom codes, concatenated
+    codes_parts, shard_ord_parts = [], []
+    for s_ord, s in enumerate(shards):
+        codes_parts.append(
+            (
+                np.searchsorted(
+                    s.chrom_offsets, np.arange(s.n_rows), side="right"
+                )
+                - 1
+            ).astype(np.int32)
+        )
+        shard_ord_parts.append(np.full(s.n_rows, s_ord, dtype=np.int32))
+    codes_all = np.concatenate(codes_parts)
+    shard_all = np.concatenate(shard_ord_parts)
+    pos_all = np.concatenate([s.cols["pos"] for s in shards])
+    row_all = np.concatenate(
+        [np.arange(s.n_rows, dtype=np.int64) for s in shards]
+    )
+    # stable order by (code, pos), shard then original row as tiebreakers —
+    # keeps each record's alt rows adjacent (lexsort: last key is primary)
+    order = np.lexsort((row_all, shard_all, pos_all, codes_all))
+
+    n = len(order)
+    out_cols = {}
+    for name in DEVICE_COLUMNS:
+        out_cols[name] = np.concatenate([s.cols[name] for s in shards])[order]
+    out_prefix = np.concatenate([s.cols["alt_prefix"] for s in shards])[order]
+
+    # rec_id renumber: records stay contiguous after the stable sort, so a
+    # change-flag cumsum yields nondecreasing ids
+    old_rec = np.concatenate([s.cols["rec_id"] for s in shards])[order]
+    old_shard = shard_all[order]
+    if n:
+        change = np.ones(n, dtype=np.int64)
+        change[1:] = (old_rec[1:] != old_rec[:-1]) | (
+            old_shard[1:] != old_shard[:-1]
+        )
+        out_cols["rec_id"] = (np.cumsum(change) - 1).astype(np.int32)
+        n_records = int(change.sum())
+    else:
+        n_records = 0
+
+    # vt vocab union + per-shard remap
+    vt_vocab: list[str] = ["N/A"]
+    vt_idx = {"N/A": 0}
+    vt_parts = []
+    for s in shards:
+        lut = np.zeros(len(s.meta["vt_vocab"]), dtype=np.int16)
+        for j, vt in enumerate(s.meta["vt_vocab"]):
+            if vt not in vt_idx:
+                vt_idx[vt] = len(vt_vocab)
+                vt_vocab.append(vt)
+            lut[j] = vt_idx[vt]
+        vt_parts.append(lut[s.vt_codes])
+    vt_codes = np.concatenate(vt_parts)[order]
+
+    same_samples = all(
+        s.meta["sample_names"] == shards[0].meta["sample_names"] for s in shards
+    )
+    gt_bits = None
+    if same_samples and all(s.gt_bits is not None for s in shards):
+        gt_bits = np.concatenate([s.gt_bits for s in shards])[order]
+
+    # blobs: offset each shard's row ids into the concatenated blob space
+    ref_blob_cat = np.concatenate([s.ref_blob for s in shards])
+    alt_blob_cat = np.concatenate([s.alt_blob for s in shards])
+
+    def _cat_offsets(get_off):
+        parts = []
+        base = 0
+        for s in shards:
+            off = get_off(s).astype(np.int64)
+            parts.append(off[:-1] + base)
+            base += int(off[-1])
+        ends = []
+        base = 0
+        for s in shards:
+            off = get_off(s).astype(np.int64)
+            ends.append(off[1:] + base)
+            base += int(off[-1])
+        return np.concatenate(parts), np.concatenate(ends)
+
+    ref_starts, ref_ends = _cat_offsets(lambda s: s.ref_off)
+    alt_starts, alt_ends = _cat_offsets(lambda s: s.alt_off)
+
+    def _regather(blob, starts, ends, order):
+        off2 = np.zeros(n + 1, dtype=np.int64)
+        lens = (ends - starts)[order]
+        np.cumsum(lens, out=off2[1:])
+        total = int(off2[-1])
+        idx = np.repeat(starts[order] - off2[:-1], lens) + np.arange(
+            total, dtype=np.int64
+        )
+        return blob[idx] if total else np.zeros(0, np.uint8), off2.astype(
+            np.uint32
+        )
+
+    ref_blob, ref_off = _regather(ref_blob_cat, ref_starts, ref_ends, order)
+    alt_blob, alt_off = _regather(alt_blob_cat, alt_starts, alt_ends, order)
+
+    chrom_offsets = np.zeros(N_CHROM_CODES + 1, dtype=np.int32)
+    sorted_codes = codes_all[order]
+    for c in range(N_CHROM_CODES + 1):
+        chrom_offsets[c] = np.searchsorted(sorted_codes, c, side="left")
+
+    chrom_native: dict[str, str] = {}
+    for s in shards:
+        for canon, native in s.meta.get("chrom_native", {}).items():
+            chrom_native.setdefault(canon, native)
+
+    meta = dict(shards[0].meta)
+    meta.update(
+        n_rows=n,
+        n_records=n_records,
+        vt_vocab=vt_vocab,
+        variant_count=n,
+        call_count=int(sum(s.meta["call_count"] for s in shards)),
+        dropped_records=int(
+            sum(s.meta.get("dropped_records", 0) for s in shards)
+        ),
+        chrom_native=chrom_native,
+        merged_from=[s.meta.get("vcf_location", "") for s in shards],
+    )
+    return VariantIndexShard(
+        meta=meta,
+        cols={**out_cols, "alt_prefix": out_prefix},
+        chrom_offsets=chrom_offsets,
+        ref_blob=ref_blob,
+        ref_off=ref_off,
+        alt_blob=alt_blob,
+        alt_off=alt_off,
+        vt_codes=vt_codes,
+        gt_bits=gt_bits,
+    )

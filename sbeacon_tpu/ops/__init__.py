@@ -1,0 +1,15 @@
+from .kernel import (
+    DeviceIndex,
+    QueryResults,
+    QuerySpec,
+    encode_queries,
+    run_queries,
+)
+
+__all__ = [
+    "DeviceIndex",
+    "QueryResults",
+    "QuerySpec",
+    "encode_queries",
+    "run_queries",
+]

@@ -1,0 +1,3 @@
+from .cpu_oracle import match_record, oracle_search
+
+__all__ = ["match_record", "oracle_search"]

@@ -1,0 +1,86 @@
+"""Typed query/response contracts.
+
+These are the framework's internal equivalents of the reference's
+lambda-to-lambda message types (reference: shared_resources/payloads/
+lambda_payloads.py:8-77 SplitQueryPayload/PerformQueryPayload and
+lambda_responses.py:15-24 PerformQueryResponse). In the reference they cross
+SNS/invoke process boundaries as JSON; here they cross the host->engine
+boundary (and the DCN boundary between an API host and TPU workers), so they
+stay dataclasses with a stable dict form.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from dataclasses import dataclass, field
+
+
+@dataclass
+class VariantQueryPayload:
+    """One variant search against one-or-more datasets.
+
+    Coordinates are **1-based inclusive**, already converted from Beacon's
+    0-based request form (the +1 dance at reference variantutils/
+    search_variants.py:65-68 happens in the API layer before this payload is
+    built).
+    """
+
+    dataset_ids: list[str] = field(default_factory=list)
+    reference_name: str = ""  # canonical chromosome, e.g. "22"
+    reference_bases: str | None = None
+    alternate_bases: str | None = None
+    start_min: int = 0
+    start_max: int = 0
+    end_min: int = 0
+    end_max: int = 0
+    variant_type: str | None = None
+    variant_min_length: int = 0
+    variant_max_length: int = -1  # -1 = unbounded
+    requested_granularity: str = "boolean"
+    include_datasets: str = "NONE"  # NONE/HIT/MISS/ALL
+    include_samples: bool = False
+    sample_names: dict[str, list[str]] = field(default_factory=dict)
+    # restrict to these samples per dataset (selected-samples path)
+    selected_samples_only: bool = False
+    query_id: str = "TEST"
+
+    @property
+    def include_details(self) -> bool:
+        # reference splitQuery: check_all = include_datasets in (HIT, ALL)
+        return self.include_datasets in ("HIT", "ALL")
+
+    def dumps(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def loads(s: str) -> "VariantQueryPayload":
+        return VariantQueryPayload(**json.loads(s))
+
+
+@dataclass
+class VariantSearchResponse:
+    """Per-(dataset, vcf) search result.
+
+    Field-compatible with the reference's PerformQueryResponse
+    (lambda_responses.py:15-24): ``variants`` entries are the same
+    tab-joined '{chrom}\\t{pos}\\t{ref}\\t{alt}\\t{vt}' strings the route
+    aggregation layer parses back (reference: getGenomicVariants/
+    route_g_variants.py:162-171).
+    """
+
+    dataset_id: str = ""
+    vcf_location: str = ""
+    exists: bool = False
+    all_alleles_count: int = 0
+    call_count: int = 0
+    variants: list[str] = field(default_factory=list)
+    sample_indices: list[int] = field(default_factory=list)
+    sample_names: list[str] = field(default_factory=list)
+
+    def dumps(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def loads(s: str) -> "VariantSearchResponse":
+        return VariantSearchResponse(**json.loads(s))

@@ -1,0 +1,215 @@
+"""Engine-level parity: full response objects vs the CPU oracle,
+including the order-sensitive truncation semantics of boolean /
+include_details=False modes."""
+
+import random
+
+import pytest
+
+from sbeacon_tpu.engine import VariantEngine, host_match_rows
+from sbeacon_tpu.index import build_index
+from sbeacon_tpu.oracle import oracle_search
+from sbeacon_tpu.ops import QuerySpec
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = random.Random(7)
+    recs_a = random_records(rng, chrom="chr5", n=500, n_samples=4,
+                            p_symbolic=0.1, p_multiallelic=0.25)
+    recs_b = random_records(rng, chrom="5", n=300, n_samples=3)
+    shard_a = build_index(recs_a, dataset_id="dsA", vcf_location="a.vcf.gz",
+                          sample_names=["a0", "a1", "a2", "a3"])
+    shard_b = build_index(recs_b, dataset_id="dsB", vcf_location="b.vcf.gz",
+                          sample_names=["b0", "b1", "b2"])
+    engine = VariantEngine()
+    engine.add_index(shard_a)
+    engine.add_index(shard_b)
+    return engine, {"dsA": recs_a, "dsB": recs_b}
+
+
+def _expected(recs, payload, chrom_label, dataset_id, vcf):
+    return oracle_search(
+        recs,
+        first_bp=payload.start_min,
+        last_bp=payload.start_max,
+        end_min=payload.end_min,
+        end_max=payload.end_max,
+        reference_bases=payload.reference_bases,
+        alternate_bases=payload.alternate_bases,
+        variant_type=payload.variant_type,
+        variant_min_length=payload.variant_min_length,
+        variant_max_length=payload.variant_max_length,
+        requested_granularity=payload.requested_granularity,
+        include_details=payload.include_details,
+        include_samples=payload.include_samples,
+        sample_names=None,
+        dataset_id=dataset_id,
+        vcf_location=vcf,
+        chrom_label=chrom_label,
+    )
+
+
+@pytest.mark.parametrize("granularity,include_ds", [
+    ("record", "HIT"),
+    ("record", "NONE"),
+    ("count", "HIT"),
+    ("boolean", "HIT"),
+    ("boolean", "NONE"),
+])
+def test_response_parity(setup, granularity, include_ds):
+    engine, recs = setup
+    rng = random.Random(31)
+    all_pos = [r.pos for r in recs["dsA"]]
+    for _ in range(12):
+        a = rng.choice(all_pos) - rng.randint(0, 1000)
+        payload = VariantQueryPayload(
+            dataset_ids=[],
+            reference_name="5",
+            reference_bases="N",
+            alternate_bases=rng.choice(["N", None, "A", "G"]),
+            variant_type=rng.choice(["DEL", "INS", None, "DUP"]),
+            start_min=max(1, a),
+            start_max=a + rng.randint(100, 4000),
+            end_min=0,
+            end_max=10**9,
+            requested_granularity=granularity,
+            include_datasets=include_ds,
+        )
+        payload.end_min = 0
+        payload.end_max = 10**9
+        got = {r.vcf_location: r for r in engine.search(payload)}
+        assert set(got) == {"a.vcf.gz", "b.vcf.gz"}
+        for ds, vcf, label in [("dsA", "a.vcf.gz", "chr5"), ("dsB", "b.vcf.gz", "5")]:
+            want = _expected(recs[ds], payload, label, ds, vcf)
+            g = got[vcf]
+            assert g.exists == want.exists, payload
+            assert g.call_count == want.call_count, payload
+            assert g.all_alleles_count == want.all_alleles_count, payload
+            assert sorted(g.variants) == sorted(want.variants), payload
+
+
+def test_missing_chromosome_skipped(setup):
+    engine, _ = setup
+    payload = VariantQueryPayload(
+        reference_name="9", start_min=1, start_max=100, end_min=0, end_max=10**9,
+        reference_bases="N", alternate_bases="N",
+    )
+    assert engine.search(payload) == []
+
+
+def test_dataset_filter(setup):
+    engine, recs = setup
+    payload = VariantQueryPayload(
+        dataset_ids=["dsB"],
+        reference_name="5",
+        start_min=1,
+        start_max=10**7,
+        end_min=0,
+        end_max=10**9,
+        reference_bases="N",
+        alternate_bases="N",
+        requested_granularity="record",
+        include_datasets="HIT",
+    )
+    got = engine.search(payload)
+    assert [g.vcf_location for g in got] == ["b.vcf.gz"]
+
+
+def test_host_match_rows_agrees_with_kernel(setup):
+    engine, recs = setup
+    (shard, dindex) = engine._indexes[("dsA", "a.vcf.gz")]
+    rng = random.Random(5)
+    from sbeacon_tpu.ops import run_queries
+
+    for _ in range(10):
+        a = rng.choice([r.pos for r in recs["dsA"]]) - rng.randint(0, 300)
+        q = QuerySpec(
+            chrom="5", start_min=max(1, a), start_max=a + 2500,
+            end_min=a, end_max=a + 4000,
+            reference_bases="N",
+            alternate_bases=rng.choice([None, "N"]),
+            variant_type="CNV",
+        )
+        res = run_queries(dindex, [q], window_cap=2048, record_cap=1024)
+        assert not res.overflow[0]
+        kernel_rows = sorted(int(r) for r in res.rows[0] if r >= 0)
+        host_rows = sorted(host_match_rows(shard, q).tolist())
+        assert kernel_rows == host_rows
+
+
+def test_sample_name_extraction(setup):
+    engine, recs = setup
+    hit = next(r for r in recs["dsA"] if any(a.upper() in "ACGT" and len(a) == 1
+                                             for a in r.alts))
+    payload = VariantQueryPayload(
+        dataset_ids=["dsA"],
+        reference_name="5",
+        start_min=hit.pos,
+        start_max=hit.pos,
+        end_min=0,
+        end_max=10**9,
+        reference_bases="N",
+        alternate_bases="N",
+        requested_granularity="record",
+        include_datasets="HIT",
+        include_samples=True,
+    )
+    got = engine.search(payload)[0]
+    oracle = oracle_search(
+        recs["dsA"],
+        first_bp=payload.start_min, last_bp=payload.start_max,
+        end_min=0, end_max=10**9,
+        reference_bases="N", alternate_bases="N",
+        requested_granularity="record", include_details=True,
+        include_samples=True, sample_names=["a0", "a1", "a2", "a3"],
+        chrom_label="chr5",
+    )
+    assert sorted(got.sample_names) == sorted(oracle.sample_names)
+
+
+def test_none_alt_none_type_matches_nothing_symbolic():
+    # regression (review finding): alternate_bases=None + variant_type=None
+    # must derive prefix '<None' (reference formatting artifact) and match
+    # no symbolic alt — kernel, host path and oracle must all agree
+    from sbeacon_tpu.genomics.vcf import VcfRecord
+    from sbeacon_tpu.index import build_index
+    from sbeacon_tpu.ops import DeviceIndex, run_queries
+
+    rec = VcfRecord("1", 100, "A", ["<INV>", "G"], [2, 3], 10, "SV", ["0|1"])
+    shard = build_index([rec])
+    dindex = DeviceIndex(shard, pad_unit=1024)
+    q = QuerySpec(chrom="1", start_min=1, start_max=1000, end_min=0,
+                  end_max=10**9, reference_bases="N", alternate_bases=None,
+                  variant_type=None)
+    res = run_queries(dindex, [q])
+    assert not res.exists[0] and res.n_matched[0] == 0
+    assert len(host_match_rows(shard, q)) == 0
+    want = oracle_search([rec], first_bp=1, last_bp=1000, end_min=0,
+                         end_max=10**9, reference_bases="N",
+                         alternate_bases=None, variant_type=None)
+    assert not want.exists
+
+
+def test_merged_shard_chrom_native_union():
+    # regression (review finding): merge must union chrom_native so
+    # chromosomes contributed only by later shards stay queryable
+    from sbeacon_tpu.index import merge_shards
+
+    rng = random.Random(21)
+    a = build_index(random_records(rng, chrom="chr1", n=30, n_samples=2),
+                    sample_names=["x", "y"])
+    b = build_index(random_records(rng, chrom="chr3", n=30, n_samples=2),
+                    sample_names=["x", "y"])
+    merged = merge_shards([a, b])
+    assert merged.meta["chrom_native"] == {"1": "chr1", "3": "chr3"}
+    engine = VariantEngine()
+    engine.add_index(merged)
+    payload = VariantQueryPayload(
+        reference_name="3", start_min=1, start_max=10**7, end_min=0,
+        end_max=10**9, reference_bases="N", alternate_bases="N",
+    )
+    got = engine.search(payload)
+    assert len(got) == 1 and got[0].exists

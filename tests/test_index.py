@@ -1,0 +1,98 @@
+import random
+
+import numpy as np
+
+from sbeacon_tpu.genomics.vcf import VcfRecord
+from sbeacon_tpu.index import build_index, load_index, merge_shards, save_index
+from sbeacon_tpu.index.columnar import FLAG, fnv1a32, pack_prefix16, prefix_mask
+from sbeacon_tpu.testing import random_records
+
+
+def test_flags_and_repeat_k():
+    recs = [
+        VcfRecord("1", 100, "AC", ["ACAC", "<DEL>", "<CN0>", "A", "."], None, None,
+                  "SV", ["0|1"]),
+        VcfRecord("1", 200, "G", ["<DUP:TANDEM>", "<CN2>", "GG", "T"], None, None,
+                  "SV", ["0|1"]),
+    ]
+    shard = build_index(recs)
+    f = shard.cols["flags"]
+    k = shard.cols["ref_repeat_k"]
+    # row order: record 1 alts in order, then record 2
+    assert k[0] == 2 and not f[0] & FLAG.SYMBOLIC  # ACAC = (AC)x2
+    assert f[1] & FLAG.SYMBOLIC and f[1] & FLAG.DEL_PREFIX
+    assert f[2] & FLAG.CN0 and f[2] & FLAG.CN_PREFIX
+    assert f[3] & FLAG.SINGLE_BASE and k[3] == -1
+    assert f[4] & FLAG.DOT
+    assert f[5] & FLAG.DUP_PREFIX and f[5] & FLAG.SYMBOLIC
+    assert f[6] & FLAG.CN2
+    assert k[7] == 2  # GG = (G)x2
+    assert f[8] & FLAG.SINGLE_BASE
+
+
+def test_prefix_pack_and_mask():
+    p = pack_prefix16(b"<DUP:TANDEM>")
+    q = pack_prefix16(b"<DUP")
+    m = prefix_mask(4)
+    assert all(((p ^ q) & m) == 0)
+    q2 = pack_prefix16(b"<DEL")
+    assert not all(((p ^ q2) & m) == 0)
+    # mask longer than data: '<DUP' padded with zeros != '<DUP:...'
+    m12 = prefix_mask(12)
+    assert not all(((p ^ q) & m12) == 0)
+
+
+def test_ac_an_materialisation():
+    rec = VcfRecord("1", 50, "A", ["G", "T"], None, None, "N/A",
+                    ["0|1", "1|2", "2/2", "."])
+    shard = build_index([rec])
+    assert list(shard.cols["ac"]) == [2, 3]
+    assert list(shard.cols["an"]) == [6, 6]
+    rec2 = VcfRecord("1", 50, "A", ["G", "T"], [9, 8], 77, "N/A", ["0|1"])
+    shard2 = build_index([rec2])
+    assert list(shard2.cols["ac"]) == [9, 8]
+    assert list(shard2.cols["an"]) == [77, 77]
+
+
+def test_gt_bitsets():
+    rec = VcfRecord("1", 50, "A", ["G", "T"], None, None, "N/A",
+                    ["0|1", "1|2", "2/2", "0/0"])
+    shard = build_index([rec], sample_names=["s0", "s1", "s2", "s3"])
+    assert shard.row_samples(0) == [0, 1]  # allele 1 in samples 0,1
+    assert shard.row_samples(1) == [1, 2]  # allele 2 in samples 1,2
+
+
+def test_save_load_roundtrip(tmp_path):
+    rng = random.Random(11)
+    recs = random_records(rng, chrom="2", n=300, n_samples=5)
+    shard = build_index(recs, dataset_id="dsX", vcf_location="file.vcf.gz",
+                        sample_names=[f"s{i}" for i in range(5)])
+    save_index(shard, tmp_path / "idx.npz")
+    back = load_index(tmp_path / "idx.npz")
+    assert back.meta["dataset_id"] == "dsX"
+    for name in shard.cols:
+        np.testing.assert_array_equal(shard.cols[name], back.cols[name])
+    np.testing.assert_array_equal(shard.gt_bits, back.gt_bits)
+    assert back.variant_string(0) == shard.variant_string(0)
+
+
+def test_merge_shards_sorted():
+    rng = random.Random(12)
+    a = build_index(random_records(rng, chrom="1", n=100, n_samples=2),
+                    sample_names=["a", "b"])
+    b = build_index(random_records(rng, chrom="1", n=100, n_samples=2),
+                    sample_names=["a", "b"])
+    c = build_index(random_records(rng, chrom="3", n=50, n_samples=2),
+                    sample_names=["a", "b"])
+    merged = merge_shards([a, b, c])
+    assert merged.n_rows == a.n_rows + b.n_rows + c.n_rows
+    pos = merged.cols["pos"]
+    off = merged.chrom_offsets
+    for code in (1, 3):
+        seg = pos[off[code]:off[code + 1]]
+        assert np.all(np.diff(seg) >= 0)
+    # rec_id nondecreasing overall
+    assert np.all(np.diff(merged.cols["rec_id"]) >= 0)
+    assert merged.meta["call_count"] == (
+        a.meta["call_count"] + b.meta["call_count"] + c.meta["call_count"]
+    )
