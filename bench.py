@@ -1,0 +1,111 @@
+"""Headline benchmark: batched Beacon point-query throughput on one chip.
+
+BASELINE.md config 2 — "10k batched SNV point queries, single dataset" —
+answered by the vmap'd sorted-index kernel (sbeacon_tpu/ops/kernel.py).
+
+Baseline derivation (the reference publishes no numbers — BASELINE.md):
+the reference answers each point query with a splitQuery->performQuery
+lambda chain whose concurrency ceiling is 1000 lambdas
+(reference: lambda/summariseVcf/lambda_function.py:25 MAX_CONCURRENCY;
+variantutils/search_variants.py THREADS=500) and whose per-query
+end-to-end latency is ~1 s (bcftools region scan + invoke overhead at the
+reference's assumed 75 MB/s scan rate, summariseVcf:23). Ceiling ~= 1000
+queries/sec. ``vs_baseline`` is measured-qps / 1000.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "queries/sec", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+
+import numpy as np
+
+N_RECORDS = 60_000
+N_QUERIES = 10_000
+REPEATS = 5
+BASELINE_QPS = 1000.0
+
+
+def main() -> None:
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ops.kernel import (
+        DeviceIndex,
+        QuerySpec,
+        encode_queries,
+        run_queries,
+    )
+    from sbeacon_tpu.testing import random_records
+
+    rng = random.Random(7)
+    records = []
+    for chrom in ("1", "22"):
+        records.extend(
+            random_records(
+                rng, chrom=chrom, n=N_RECORDS // 2, n_samples=8, spacing=40
+            )
+        )
+    shard = build_index(records, dataset_id="bench", with_genotypes=False)
+    dindex = DeviceIndex(shard)
+
+    # point queries: half exact hits sampled from the index, half misses
+    qrng = random.Random(11)
+    specs = []
+    n_rows = shard.n_rows
+    for i in range(N_QUERIES):
+        if i % 2 == 0:
+            r = qrng.randrange(n_rows)
+            pos = int(shard.cols["pos"][r])
+            specs.append(
+                QuerySpec(
+                    shard.row_chrom(r),
+                    pos,
+                    pos,
+                    1,
+                    2**30,
+                    reference_bases=shard.row_ref(r),
+                    alternate_bases=shard.row_alt(r),
+                )
+            )
+        else:
+            pos = qrng.randrange(1, 3_000_000)
+            specs.append(
+                QuerySpec("1", pos, pos, 1, 2**30, alternate_bases="T")
+            )
+    enc = encode_queries(specs)
+
+    # warm-up compiles the kernel
+    res = run_queries(dindex, enc, window_cap=512, record_cap=64)
+    n_hits = int(res.exists.sum())
+
+    times = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        run_queries(dindex, enc, window_cap=512, record_cap=64)
+        times.append(time.perf_counter() - t0)
+    best = min(times)
+    qps = N_QUERIES / best
+
+    print(
+        json.dumps(
+            {
+                "metric": "batched_point_queries_single_chip",
+                "value": round(qps, 1),
+                "unit": "queries/sec",
+                "vs_baseline": round(qps / BASELINE_QPS, 2),
+                "detail": {
+                    "n_queries": N_QUERIES,
+                    "index_rows": n_rows,
+                    "best_batch_s": round(best, 4),
+                    "hits": n_hits,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

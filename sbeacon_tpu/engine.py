@@ -228,7 +228,7 @@ def materialize_response(
         all_alleles_count=all_alleles,
         call_count=call_count,
         variants=variants,
-        sample_indices=[],
+        sample_indices=sorted(sample_indices),
         sample_names=resolved,
     )
 

@@ -128,6 +128,45 @@ def encode_queries(queries: list[QuerySpec]) -> dict[str, np.ndarray]:
     return enc
 
 
+# per-column padding fill values (pos/rec_end/rec_id = INT32_MAX so no
+# searchsorted window ever selects a padding row)
+_PAD_FILLS = {
+    "pos": INT32_MAX,
+    "rec_end": INT32_MAX,
+    "ref_len": 0,
+    "alt_len": 0,
+    "ref_hash": 0,
+    "alt_hash": 0,
+    "ref_repeat_k": -1,
+    "flags": 0,
+    "ac": 0,
+    "an": 0,
+    "rec_id": INT32_MAX,
+    "alt_prefix": 0,
+}
+
+
+def pad_shard_columns(
+    shard: VariantIndexShard, n_pad: int
+) -> dict[str, np.ndarray]:
+    """Host-side padded column dict (incl. chrom_offsets), numpy only."""
+    n = shard.n_rows
+    if n > n_pad:
+        raise ValueError(f"shard has {n} rows > pad target {n_pad}")
+    out = {}
+    for name, fill in _PAD_FILLS.items():
+        col = shard.cols[name]
+        padded = np.full((n_pad,) + col.shape[1:], fill, dtype=col.dtype)
+        padded[:n] = col
+        out[name] = padded
+    out["chrom_offsets"] = shard.chrom_offsets.astype(np.int32)
+    return out
+
+
+def padded_rows(n: int, pad_unit: int) -> int:
+    return max(pad_unit, ((n + pad_unit - 1) // pad_unit) * pad_unit)
+
+
 class DeviceIndex:
     """A VariantIndexShard's device-bound columns, padded to a static shape.
 
@@ -140,35 +179,13 @@ class DeviceIndex:
     def __init__(self, shard: VariantIndexShard, pad_unit: int | None = None):
         pad_unit = pad_unit or self.PAD_UNIT
         n = shard.n_rows
-        n_pad = max(pad_unit, ((n + pad_unit - 1) // pad_unit) * pad_unit)
+        n_pad = padded_rows(n, pad_unit)
         self.n_rows = n
         self.n_padded = n_pad
         self.shard = shard
-
-        def pad(col: np.ndarray, fill) -> np.ndarray:
-            if col.ndim == 1:
-                out = np.full(n_pad, fill, dtype=col.dtype)
-                out[:n] = col
-            else:
-                out = np.full((n_pad,) + col.shape[1:], fill, dtype=col.dtype)
-                out[:n] = col
-            return out
-
-        c = shard.cols
         self.arrays = {
-            "pos": jnp.asarray(pad(c["pos"], INT32_MAX)),
-            "rec_end": jnp.asarray(pad(c["rec_end"], INT32_MAX)),
-            "ref_len": jnp.asarray(pad(c["ref_len"], 0)),
-            "alt_len": jnp.asarray(pad(c["alt_len"], 0)),
-            "ref_hash": jnp.asarray(pad(c["ref_hash"], 0)),
-            "alt_hash": jnp.asarray(pad(c["alt_hash"], 0)),
-            "ref_repeat_k": jnp.asarray(pad(c["ref_repeat_k"], -1)),
-            "flags": jnp.asarray(pad(c["flags"], 0)),
-            "ac": jnp.asarray(pad(c["ac"], 0)),
-            "an": jnp.asarray(pad(c["an"], 0)),
-            "rec_id": jnp.asarray(pad(c["rec_id"], INT32_MAX)),
-            "alt_prefix": jnp.asarray(pad(c["alt_prefix"], 0)),
-            "chrom_offsets": jnp.asarray(shard.chrom_offsets.astype(np.int32)),
+            k: jnp.asarray(v)
+            for k, v in pad_shard_columns(shard, n_pad).items()
         }
         self.n_iters = max(1, math.ceil(math.log2(n_pad + 1)))
 
@@ -186,8 +203,13 @@ class QueryResults:
     rows: np.ndarray  # int32[B, record_cap] global row ids, -1 padded
 
 
-def _lower_bound(pos, target, lo0, hi0, n_iters):
-    """First index in [lo0, hi0) with pos[idx] >= target (fixed depth)."""
+def _bisect(pos, target, lo0, hi0, n_iters, *, upper: bool):
+    """Fixed-depth bisection over pos[lo0:hi0].
+
+    upper=False: first index with pos[idx] >= target (lower bound).
+    upper=True:  first index with pos[idx] >  target (upper bound) — used
+    instead of lower_bound(target+1) so target=INT32_MAX cannot wrap.
+    """
 
     def body(carry, _):
         lo, hi = carry
@@ -195,7 +217,7 @@ def _lower_bound(pos, target, lo0, hi0, n_iters):
         # pos[mid] outside [lo0, hi0) and walk past the segment end
         active = lo < hi
         mid = (lo + hi) // 2
-        less = pos[mid] < target
+        less = pos[mid] <= target if upper else pos[mid] < target
         return (
             jnp.where(active & less, mid + 1, lo),
             jnp.where(active & ~less, mid, hi),
@@ -212,8 +234,8 @@ def _query_one(arrays, q, *, window_cap: int, record_cap: int, n_iters: int):
 
     seg_lo = offsets[q["chrom"]]
     seg_hi = offsets[q["chrom"] + 1]
-    lo = _lower_bound(pos, q["start_min"], seg_lo, seg_hi, n_iters)
-    hi = _lower_bound(pos, q["start_max"] + 1, seg_lo, seg_hi, n_iters)
+    lo = _bisect(pos, q["start_min"], seg_lo, seg_hi, n_iters, upper=False)
+    hi = _bisect(pos, q["start_max"], seg_lo, seg_hi, n_iters, upper=True)
 
     idxs = lo + jnp.arange(window_cap, dtype=jnp.int32)
     valid = idxs < hi

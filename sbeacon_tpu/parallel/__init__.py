@@ -1,0 +1,13 @@
+from .mesh import (
+    StackedIndex,
+    aggregate_struct,
+    make_mesh,
+    sharded_query,
+)
+
+__all__ = [
+    "StackedIndex",
+    "aggregate_struct",
+    "make_mesh",
+    "sharded_query",
+]

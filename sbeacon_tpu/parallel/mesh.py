@@ -1,0 +1,223 @@
+"""Dataset-sharded query execution over a ``jax.sharding.Mesh``.
+
+This is the TPU-native replacement for the reference's *entire* distributed
+fan-out/fan-in apparatus: the 500-thread dataset scatter (reference:
+shared_resources/variantutils/search_variants.py:77-118), the SNS splitQuery/
+performQuery process boundaries, and the DynamoDB atomic fan-in counter
+(dynamodb/variant_queries.py:45-59) collapse into ONE compiled program:
+
+- datasets (one index shard per (dataset, vcf)) are stacked on a leading
+  axis and sharded over mesh axis ``d`` — the scatter is the sharding;
+- every device answers the full query batch against its local dataset
+  shards (vmap over datasets × vmap over queries);
+- fan-in is ``lax.psum`` over ``d`` for the cross-dataset aggregates
+  (exists / call_count / allele counts), i.e. the ICI collective replaces
+  the counter+poll state machine entirely;
+- per-dataset results (the PerformQueryResponse set) stay device-sharded
+  and are gathered only when record-granularity materialisation needs them.
+
+Multi-host: the same program runs under jax.distributed with a global mesh;
+shardings are expressed once and XLA lays collectives onto ICI/DCN.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..index.columnar import N_CHROM_CODES, VariantIndexShard
+from ..ops.kernel import (
+    DeviceIndex,
+    _query_one,
+    encode_queries,
+    pad_shard_columns,
+    padded_rows,
+)
+
+AXIS = "d"
+
+
+def make_mesh(n_devices: int | None = None, axis: str = AXIS) -> Mesh:
+    """1-D device mesh over the first ``n_devices`` local devices."""
+    devices = jax.devices()
+    if n_devices is not None:
+        if n_devices > len(devices):
+            raise ValueError(
+                f"requested {n_devices} devices, only {len(devices)} available"
+            )
+        devices = devices[:n_devices]
+    return Mesh(np.array(devices), (axis,))
+
+
+class StackedIndex:
+    """D dataset shards padded to a common row count and stacked: [D, Np].
+
+    The stack is the unit the mesh shards: axis 0 is partitioned over the
+    ``d`` mesh axis. D is padded up to a multiple of the mesh size with
+    empty datasets (all-zero chrom_offsets -> no query ever selects a row).
+    """
+
+    def __init__(
+        self,
+        shards: list[VariantIndexShard],
+        *,
+        n_datasets_padded: int | None = None,
+        pad_unit: int = DeviceIndex.PAD_UNIT,
+    ):
+        if not shards:
+            raise ValueError("StackedIndex needs at least one shard")
+        self.shards = shards
+        d = len(shards)
+        d_pad = n_datasets_padded or d
+        if d_pad < d:
+            raise ValueError("n_datasets_padded < number of shards")
+        n_max = max(s.n_rows for s in shards)
+        n_pad = padded_rows(n_max, pad_unit)
+        self.n_datasets = d
+        self.n_datasets_padded = d_pad
+        self.n_padded = n_pad
+
+        # all padding happens host-side; device transfer occurs exactly once,
+        # in shard_to_mesh, with the real sharding
+        per = [pad_shard_columns(s, n_pad) for s in shards]
+        names = [k for k in per[0] if k != "chrom_offsets"]
+        self.arrays = {}
+        for name in names:
+            mats = [p[name] for p in per]
+            # padding datasets reuse shard 0's padded tail row, whose values
+            # are the canonical fills; their all-zero chrom_offsets make them
+            # unreachable regardless
+            fill = mats[0][-1]
+            self.arrays[name] = np.stack(
+                mats + [np.full_like(mats[0], fill)] * (d_pad - d)
+            )
+        self.arrays["chrom_offsets"] = np.stack(
+            [p["chrom_offsets"] for p in per]
+            + [np.zeros(N_CHROM_CODES + 1, np.int32)] * (d_pad - d)
+        )
+        self.n_iters = max(1, math.ceil(math.log2(n_pad + 1)))
+
+    def shard_to_mesh(self, mesh: Mesh, axis: str = AXIS) -> dict:
+        """Device-put the stack with axis 0 partitioned over ``axis``."""
+        sharding = NamedSharding(mesh, P(axis))
+        return {
+            k: jax.device_put(jnp.asarray(v), sharding)
+            for k, v in self.arrays.items()
+        }
+
+
+def _local_query(arrays_local, enc, *, window_cap, record_cap, n_iters, axis):
+    """Body run per device: vmap datasets × vmap queries, psum fan-in."""
+
+    def one_dataset(arrays_one):
+        fn = partial(
+            _query_one,
+            arrays_one,
+            window_cap=window_cap,
+            record_cap=record_cap,
+            n_iters=n_iters,
+        )
+        return jax.vmap(fn)(enc)
+
+    per_ds = jax.vmap(one_dataset)(arrays_local)  # leaves: [d_local, B, ...]
+
+    # cross-dataset fan-in: local reduce then one psum over the mesh axis —
+    # this collective IS the reference's DynamoDB fanOut counter + poll loop
+    agg = {
+        "call_count": jax.lax.psum(
+            jnp.sum(per_ds["call_count"], axis=0), axis
+        ),
+        "all_alleles_count": jax.lax.psum(
+            jnp.sum(per_ds["all_alleles_count"], axis=0), axis
+        ),
+        "n_variants": jax.lax.psum(
+            jnp.sum(per_ds["n_variants"], axis=0), axis
+        ),
+        "n_datasets_hit": jax.lax.psum(
+            jnp.sum(per_ds["exists"].astype(jnp.int32), axis=0), axis
+        ),
+        "n_overflow": jax.lax.psum(
+            jnp.sum(per_ds["overflow"].astype(jnp.int32), axis=0), axis
+        ),
+    }
+    agg["exists"] = agg["call_count"] > 0
+    return per_ds, agg
+
+
+_FN_CACHE: dict = {}
+
+
+def _build_sharded_fn(mesh: Mesh, axis: str, window_cap, record_cap, n_iters):
+    key = (mesh, axis, window_cap, record_cap, n_iters)
+    if key in _FN_CACHE:
+        return _FN_CACHE[key]
+    body = partial(
+        _local_query,
+        window_cap=window_cap,
+        record_cap=record_cap,
+        n_iters=n_iters,
+        axis=axis,
+    )
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=(P(axis), P()),
+    )
+    fn = jax.jit(mapped)
+    _FN_CACHE[key] = fn
+    return fn
+
+
+def sharded_query(
+    stacked_arrays: dict,
+    queries,
+    *,
+    mesh: Mesh,
+    n_iters: int,
+    axis: str = AXIS,
+    window_cap: int = 2048,
+    record_cap: int = 1024,
+):
+    """Run a query batch against a mesh-sharded dataset stack.
+
+    Returns (per_dataset, aggregates) as numpy: per_dataset leaves are
+    [D, B, ...] (D = padded dataset count), aggregates are [B]-shaped
+    cross-dataset reductions computed with psum over the mesh.
+    """
+    enc = (
+        encode_queries(queries) if isinstance(queries, list) else queries
+    )
+    enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
+    fn = _build_sharded_fn(mesh, axis, window_cap, record_cap, n_iters)
+    per_ds, agg = fn(stacked_arrays, enc_dev)
+    per_ds = jax.device_get(per_ds)
+    agg = jax.device_get(agg)
+    return (
+        {k: np.asarray(v) for k, v in per_ds.items()},
+        {k: np.asarray(v) for k, v in agg.items()},
+    )
+
+
+def aggregate_struct(agg: dict) -> dict:
+    """Human-readable summary of the psum aggregates for one query.
+
+    ``n_overflow`` > 0 means at least one dataset's candidate window was
+    truncated at window_cap: the aggregates are then lower bounds and the
+    caller must re-answer those datasets on host (engine.host_match_rows),
+    exactly like the single-device engine's overflow fallback.
+    """
+    return {
+        "exists": bool(agg["exists"]),
+        "call_count": int(agg["call_count"]),
+        "all_alleles_count": int(agg["all_alleles_count"]),
+        "n_variants": int(agg["n_variants"]),
+        "n_datasets_hit": int(agg["n_datasets_hit"]),
+        "n_overflow": int(agg["n_overflow"]),
+        "exact": int(agg["n_overflow"]) == 0,
+    }

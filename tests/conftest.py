@@ -13,3 +13,10 @@ if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+# The environment may have imported jax at interpreter startup (site hooks)
+# with a TPU platform pinned; backends initialise lazily, so a config update
+# here still lands before any device is created.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -249,3 +249,25 @@ def test_window_overflow_flagged():
     )
     res = run_queries(dindex, [q], window_cap=64)
     assert res.overflow[0]
+
+
+def test_int32_max_start_max_does_not_wrap(dataset):
+    """start_max=INT32_MAX (the unbounded sentinel) must not overflow the
+    device-side upper-bound search (regression: lower_bound(target+1) wrapped
+    to INT32_MIN and returned zero matches with no overflow flag)."""
+    records, shard, dindex = dataset
+    from sbeacon_tpu.engine import host_match_rows
+
+    spec = QuerySpec(
+        chrom="1",
+        start_min=1,
+        start_max=2**31 - 1,
+        end_min=1,
+        end_max=2**30,
+        alternate_bases="N",
+    )
+    res = run_queries(dindex, [spec], window_cap=8192, record_cap=4096)
+    want = host_match_rows(shard, spec)
+    assert not res.overflow[0]
+    assert int(res.n_matched[0]) == len(want)
+    assert len(want) > 0
