@@ -1,0 +1,296 @@
+"""Embedded columnar metadata engine.
+
+Plays the role of the reference's entire Athena/Glue metadata plane — the
+six ORC entity tables, the terms/terms_index/relations CTAS products, and
+the AthenaModel query API (reference: athena.tf:15-851; shared_resources/
+athena/common.py AthenaModel.get_by_query/get_count_by_query/
+get_existence_by_query) — as one sqlite database with the same query
+surface and no polling: queries return in microseconds instead of the
+reference's 0.1 s x 300 Athena poll loop (athena/common.py:151-165).
+
+Entity documents are stored whole (JSON) plus one lowercased SQL column per
+filterable field, so the filter compiler's generated SQL runs verbatim.
+``rebuild_indexes`` is the indexer lambda equivalent (reference:
+lambda/indexer/lambda_function.py index_terms/record_terms/record_relations):
+it derives terms, terms_index and the six-way relations join from current
+entity rows in three CREATE-AS statements.
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+from pathlib import Path
+
+from .entities import ENTITY_COLUMNS, ENTITY_KINDS, extract_terms
+from .filters import entity_search_conditions
+from .ontology import OntologyStore
+
+
+def _sql_value(doc: dict, col: str) -> str:
+    """Column value from a doc: '_assemblyId' accepts either the private
+    key or its public 'assemblyId' spelling (the reference models take
+    assemblyId= and store _assemblyId)."""
+    v = doc.get(col)
+    if v is None and col.startswith("_"):
+        v = doc.get(col[1:])
+    if v is None:
+        return ""
+    if isinstance(v, str):
+        return v
+    return json.dumps(v)
+
+
+class MetadataStore:
+    def __init__(
+        self,
+        path: str | Path = ":memory:",
+        *,
+        ontology: OntologyStore | None = None,
+    ):
+        if path != ":memory:":
+            Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self.conn = sqlite3.connect(str(path), check_same_thread=False)
+        self._lock = threading.Lock()
+        self.ontology = ontology
+        self._create_tables()
+
+    def _create_tables(self) -> None:
+        cur = self.conn.cursor()
+        for kind, cols in ENTITY_COLUMNS.items():
+            col_defs = ", ".join(
+                f"{c.lower()} TEXT" + (" PRIMARY KEY" if c == "id" else "")
+                for c in cols
+            )
+            cur.execute(
+                f"CREATE TABLE IF NOT EXISTS {kind} ({col_defs}, _doc TEXT)"
+            )
+        cur.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS terms_cache (
+                kind TEXT, id TEXT, term TEXT, label TEXT, type TEXT
+            );
+            CREATE INDEX IF NOT EXISTS terms_cache_kind_id
+                ON terms_cache (kind, id);
+            CREATE TABLE IF NOT EXISTS terms (
+                term TEXT, label TEXT, type TEXT, kind TEXT
+            );
+            CREATE TABLE IF NOT EXISTS terms_index (
+                id TEXT, term TEXT, kind TEXT
+            );
+            CREATE TABLE IF NOT EXISTS relations (
+                datasetid TEXT, cohortid TEXT, individualid TEXT,
+                biosampleid TEXT, runid TEXT, analysisid TEXT
+            );
+            """
+        )
+        self.conn.commit()
+
+    # -- writes -------------------------------------------------------------
+
+    def upsert(self, kind: str, docs: list[dict]) -> None:
+        """Insert-or-replace entity documents; refresh their term cache rows
+        (reference: per-entity upload_array ORC + terms-cache writes)."""
+        if kind not in ENTITY_COLUMNS:
+            raise ValueError(f"unknown entity kind {kind!r}")
+        cols = ENTITY_COLUMNS[kind]
+        col_names = ", ".join(c.lower() for c in cols) + ", _doc"
+        placeholders = ", ".join("?" for _ in range(len(cols) + 1))
+        with self._lock:
+            cur = self.conn.cursor()
+            for doc in docs:
+                row = [_sql_value(doc, c) for c in cols]
+                row.append(json.dumps(doc))
+                cur.execute(
+                    f"INSERT OR REPLACE INTO {kind} ({col_names}) "
+                    f"VALUES ({placeholders})",
+                    row,
+                )
+                cur.execute(
+                    "DELETE FROM terms_cache WHERE kind = ? AND id = ?",
+                    (kind, doc.get("id", "")),
+                )
+                cur.executemany(
+                    "INSERT INTO terms_cache VALUES (?, ?, ?, ?, ?)",
+                    [
+                        (kind, doc.get("id", ""), term, label, typ)
+                        for term, label, typ in extract_terms(doc)
+                    ],
+                )
+            self.conn.commit()
+
+    def delete(self, kind: str, entity_id: str) -> None:
+        with self._lock:
+            self.conn.execute(
+                f"DELETE FROM {kind} WHERE id = ?", (entity_id,)
+            )
+            self.conn.execute(
+                "DELETE FROM terms_cache WHERE kind = ? AND id = ?",
+                (kind, entity_id),
+            )
+            self.conn.commit()
+
+    # -- the indexer (reference lambda/indexer CTAS trio) -------------------
+
+    def rebuild_indexes(self) -> None:
+        with self._lock:
+            cur = self.conn.cursor()
+            cur.execute("DELETE FROM terms")
+            cur.execute(
+                "INSERT INTO terms "
+                "SELECT DISTINCT term, label, type, kind FROM terms_cache "
+                "ORDER BY term ASC"
+            )
+            cur.execute("DELETE FROM terms_index")
+            cur.execute(
+                "INSERT INTO terms_index "
+                "SELECT DISTINCT id, term, kind FROM terms_cache"
+            )
+            cur.execute("DELETE FROM relations")
+            # six-way entity join (reference generate_query_relations.py)
+            cur.execute(
+                """
+                INSERT INTO relations
+                SELECT
+                    D.id AS datasetid,
+                    C.id AS cohortid,
+                    I.id AS individualid,
+                    B.id AS biosampleid,
+                    R.id AS runid,
+                    A.id AS analysisid
+                FROM datasets D
+                LEFT OUTER JOIN individuals I ON D.id = I._datasetid
+                LEFT OUTER JOIN biosamples B ON I.id = B.individualid
+                LEFT OUTER JOIN runs R ON B.id = R.biosampleid
+                LEFT OUTER JOIN analyses A ON R.id = A.runid
+                FULL OUTER JOIN cohorts C ON C.id = I._cohortid
+                """
+            )
+            self.conn.commit()
+
+    # -- query surface (AthenaModel equivalents) ----------------------------
+
+    def _compile(self, filters, kind, **kw):
+        return entity_search_conditions(
+            filters, kind, kind, ontology=self.ontology, **kw
+        )
+
+    def fetch(
+        self,
+        kind: str,
+        filters: list[dict] | None = None,
+        *,
+        skip: int = 0,
+        limit: int = 100,
+        extra_where: str | None = None,
+        extra_params: list | None = None,
+    ) -> list[dict]:
+        """Record-granularity page, ordered by id (reference
+        get_record_query ORDER BY id OFFSET/LIMIT)."""
+        where, params = self._compile(filters or [], kind)
+        if extra_where:
+            where = (
+                f"{where} AND {extra_where}"
+                if where
+                else f"WHERE {extra_where}"
+            )
+            params = params + list(extra_params or [])
+        sql = (
+            f"SELECT _doc FROM {kind} {where} "
+            f"ORDER BY id LIMIT ? OFFSET ?"
+        )
+        rows = self.conn.execute(sql, [*params, limit, skip]).fetchall()
+        return [json.loads(r[0]) for r in rows]
+
+    def count(self, kind: str, filters: list[dict] | None = None) -> int:
+        where, params = self._compile(filters or [], kind)
+        sql = f"SELECT COUNT(*) FROM {kind} {where}"
+        return int(self.conn.execute(sql, params).fetchone()[0])
+
+    def exists(self, kind: str, filters: list[dict] | None = None) -> bool:
+        return self.count(kind, filters) > 0
+
+    def get_by_id(self, kind: str, entity_id: str) -> dict | None:
+        row = self.conn.execute(
+            f"SELECT _doc FROM {kind} WHERE id = ?", (entity_id,)
+        ).fetchone()
+        return json.loads(row[0]) if row else None
+
+    def query(self, sql: str, params: list | tuple = ()) -> list[tuple]:
+        """Raw parameterised SQL (the run_custom_query escape hatch)."""
+        return self.conn.execute(sql, params).fetchall()
+
+    # -- filtering terms ----------------------------------------------------
+
+    def filtering_terms(
+        self, *, skip: int = 0, limit: int = 100, kinds: list[str] | None = None
+    ) -> list[dict]:
+        """Paginated distinct terms (reference getFilteringTerms SELECT
+        DISTINCT term, label, type ORDER BY term)."""
+        where = ""
+        params: list = []
+        if kinds:
+            where = f"WHERE kind IN ({', '.join('?' for _ in kinds)})"
+            params = list(kinds)
+        rows = self.conn.execute(
+            f"SELECT DISTINCT term, label, type FROM terms {where} "
+            f"ORDER BY term ASC LIMIT ? OFFSET ?",
+            [*params, limit, skip],
+        ).fetchall()
+        return [
+            {"id": t, "label": lb, "type": ty} for t, lb, ty in rows
+        ]
+
+    # -- dataset helpers (reference athena/dataset.py get_datasets) ---------
+
+    def datasets_for_assembly(
+        self,
+        assembly_id: str,
+        *,
+        dataset_ids: list[str] | None = None,
+        filters: list[dict] | None = None,
+        skip: int = 0,
+        limit: int = 1_000_000,
+    ) -> list[dict]:
+        extra = "LOWER(_assemblyid) = LOWER(?)"
+        params: list = [assembly_id]
+        if dataset_ids:
+            extra += f" AND id IN ({', '.join('?' for _ in dataset_ids)})"
+            params.extend(dataset_ids)
+        return self.fetch(
+            "datasets",
+            filters or [],
+            skip=skip,
+            limit=limit,
+            extra_where=extra,
+            extra_params=params,
+        )
+
+    def _sample_names_via_analyses(
+        self, column: str, entity_id: str
+    ) -> dict[str, list[str]]:
+        """dataset_id -> vcf sample names via the analyses table
+        (reference route_individuals_id_g_variants.py:23-34 Athena join)."""
+        rows = self.conn.execute(
+            f"SELECT _datasetid, _vcfsampleid FROM analyses "
+            f"WHERE {column} = ? AND _vcfsampleid != ''",
+            (entity_id,),
+        ).fetchall()
+        out: dict[str, list[str]] = {}
+        for ds, sample in rows:
+            out.setdefault(ds, []).append(sample)
+        return out
+
+    def sample_names_for_individual(
+        self, individual_id: str
+    ) -> dict[str, list[str]]:
+        return self._sample_names_via_analyses("individualid", individual_id)
+
+    def sample_names_for_biosample(
+        self, biosample_id: str
+    ) -> dict[str, list[str]]:
+        return self._sample_names_via_analyses("biosampleid", biosample_id)
+
+    def close(self) -> None:
+        self.conn.close()

@@ -167,6 +167,11 @@ def padded_rows(n: int, pad_unit: int) -> int:
     return max(pad_unit, ((n + pad_unit - 1) // pad_unit) * pad_unit)
 
 
+def bisect_iters(n_pad: int) -> int:
+    """Fixed bisection depth covering a padded row count."""
+    return max(1, math.ceil(math.log2(n_pad + 1)))
+
+
 class DeviceIndex:
     """A VariantIndexShard's device-bound columns, padded to a static shape.
 
@@ -187,7 +192,7 @@ class DeviceIndex:
             k: jnp.asarray(v)
             for k, v in pad_shard_columns(shard, n_pad).items()
         }
-        self.n_iters = max(1, math.ceil(math.log2(n_pad + 1)))
+        self.n_iters = bisect_iters(n_pad)
 
 
 @dataclass

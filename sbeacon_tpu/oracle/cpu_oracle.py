@@ -314,6 +314,6 @@ def oracle_search(
         all_alleles_count=all_alleles_count,
         call_count=call_count,
         variants=variants,
-        sample_indices=[],
+        sample_indices=sorted(sample_indices),
         sample_names=resolved_names,
     )

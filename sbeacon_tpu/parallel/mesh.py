@@ -22,7 +22,6 @@ shardings are expressed once and XLA lays collectives onto ICI/DCN.
 
 from __future__ import annotations
 
-import math
 from functools import partial
 
 import jax
@@ -34,6 +33,7 @@ from ..index.columnar import N_CHROM_CODES, VariantIndexShard
 from ..ops.kernel import (
     DeviceIndex,
     _query_one,
+    bisect_iters,
     encode_queries,
     pad_shard_columns,
     padded_rows,
@@ -100,7 +100,7 @@ class StackedIndex:
             [p["chrom_offsets"] for p in per]
             + [np.zeros(N_CHROM_CODES + 1, np.int32)] * (d_pad - d)
         )
-        self.n_iters = max(1, math.ceil(math.log2(n_pad + 1)))
+        self.n_iters = bisect_iters(n_pad)
 
     def shard_to_mesh(self, mesh: Mesh, axis: str = AXIS) -> dict:
         """Device-put the stack with axis 0 partitioned over ``axis``."""

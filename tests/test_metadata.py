@@ -1,0 +1,233 @@
+"""Metadata engine: entity store, relations, filter compiler, ontology."""
+
+import pytest
+
+from sbeacon_tpu.metadata import (
+    MetadataStore,
+    OntologyStore,
+    entity_search_conditions,
+    extract_terms,
+)
+from sbeacon_tpu.metadata.filters import FilterError
+
+
+@pytest.fixture()
+def onto():
+    o = OntologyStore()
+    # tiny is-a tree:   HP:1 -> HP:2 -> HP:4
+    #                        \-> HP:3
+    o.register_edges(
+        [("HP:2", "HP:1"), ("HP:3", "HP:1"), ("HP:4", "HP:2")]
+    )
+    return o
+
+
+@pytest.fixture()
+def store(onto):
+    s = MetadataStore(ontology=onto)
+    s.upsert(
+        "datasets",
+        [
+            {"id": "ds1", "assemblyId": "GRCh38", "name": "One",
+             "vcfLocations": ["a.vcf.gz"]},
+            {"id": "ds2", "assemblyId": "grch38", "name": "Two",
+             "vcfLocations": ["b.vcf.gz"]},
+            {"id": "ds3", "assemblyId": "GRCh37", "name": "Three"},
+        ],
+    )
+    s.upsert(
+        "individuals",
+        [
+            {"id": "i1", "datasetId": "ds1", "sex": {"id": "NCIT:C16576",
+             "label": "female"}, "karyotypicSex": "XX",
+             "diseases": [{"diseaseCode": {"id": "HP:4", "label": "leaf"}}]},
+            {"id": "i2", "datasetId": "ds1", "sex": {"id": "NCIT:C20197",
+             "label": "male"}, "karyotypicSex": "XY"},
+            {"id": "i3", "datasetId": "ds2", "sex": {"id": "NCIT:C16576",
+             "label": "female"}, "karyotypicSex": "XX",
+             "diseases": [{"diseaseCode": {"id": "HP:3", "label": "mid"}}]},
+        ],
+    )
+    s.upsert(
+        "biosamples",
+        [
+            {"id": "b1", "datasetId": "ds1", "individualId": "i1",
+             "sampleOriginType": {"id": "UBERON:0000178", "label": "blood"}},
+            {"id": "b2", "datasetId": "ds2", "individualId": "i3",
+             "sampleOriginType": {"id": "UBERON:0000955", "label": "brain"}},
+        ],
+    )
+    s.upsert(
+        "runs",
+        [{"id": "r1", "datasetId": "ds1", "biosampleId": "b1",
+          "individualId": "i1", "platform": "Illumina"}],
+    )
+    s.upsert(
+        "analyses",
+        [{"id": "a1", "datasetId": "ds1", "runId": "r1", "individualId": "i1",
+          "biosampleId": "b1", "vcfSampleId": "S0001"}],
+    )
+    s.upsert("cohorts", [{"id": "c1", "name": "Cohort 1"}])
+    s.rebuild_indexes()
+    return s
+
+
+def test_extract_terms_walks_nested_docs():
+    doc = {
+        "id": "i1",  # not CURIE-shaped -> skipped
+        "sex": {"id": "NCIT:C16576", "label": "female"},
+        "diseases": [
+            {"diseaseCode": {"id": "HP:4", "label": "leaf"},
+             "stage": {"id": "OGMS:0000119", "label": "acute"}}
+        ],
+    }
+    terms = {t for t, _, _ in extract_terms(doc)}
+    assert terms == {"NCIT:C16576", "HP:4", "OGMS:0000119"}
+
+
+def test_fetch_count_exists_no_filters(store):
+    assert store.count("individuals") == 3
+    assert store.exists("datasets")
+    docs = store.fetch("individuals", limit=2, skip=1)
+    assert [d["id"] for d in docs] == ["i2", "i3"]
+
+
+def test_own_column_filter(store):
+    f = [{"id": "karyotypicSex", "operator": "=", "value": "XX"}]
+    assert store.count("individuals", f) == 2
+    f = [{"id": "karyotypicSex", "operator": "!", "value": "XX"}]
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i2"]
+
+
+def test_ontology_term_filter_descendant_expansion(store):
+    # HP:2's descendants = {HP:2, HP:4}; only i1 carries HP:4
+    f = [{"id": "HP:2"}]
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i1"]
+    # HP:1 expands to the whole family incl HP:3 (i3)
+    f = [{"id": "HP:1"}]
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i1", "i3"]
+    # no descendant expansion: HP:2 itself is on nobody
+    f = [{"id": "HP:2", "includeDescendantTerms": False}]
+    assert store.count("individuals", f) == 0
+
+
+def test_similarity_tiers(store, onto):
+    # low similarity from HP:4 walks up to HP:1's family -> hits i1 and i3
+    f = [{"id": "HP:4", "similarity": "low"}]
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i1", "i3"]
+
+
+def test_cross_entity_scope_filter(store):
+    # individuals constrained by a biosample-scoped term
+    f = [{"id": "UBERON:0000178", "scope": "biosamples"}]
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i1"]
+
+
+def test_linked_class_column_filter(store):
+    # datasets filtered by a linked Individual column
+    f = [{"id": "Individual.karyotypicSex", "operator": "=", "value": "XY"}]
+    assert [d["id"] for d in store.fetch("datasets", f)] == ["ds1"]
+
+
+def test_filter_intersection(store):
+    f = [
+        {"id": "NCIT:C16576"},  # female: i1, i3
+        {"id": "HP:1"},  # disease family: i1, i3
+        {"id": "karyotypicSex", "operator": "=", "value": "XX"},
+    ]
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i1", "i3"]
+    f.append({"id": "UBERON:0000955", "scope": "biosamples"})  # brain: i3
+    assert [d["id"] for d in store.fetch("individuals", f)] == ["i3"]
+
+
+def test_assembly_dataset_lookup_case_insensitive(store):
+    ds = store.datasets_for_assembly("GRCh38")
+    assert {d["id"] for d in ds} == {"ds1", "ds2"}
+    ds = store.datasets_for_assembly("GRCh38", dataset_ids=["ds2"])
+    assert [d["id"] for d in ds] == ["ds2"]
+
+
+def test_filtering_terms_pagination(store):
+    terms = store.filtering_terms(limit=100)
+    ids = [t["id"] for t in terms]
+    assert "NCIT:C16576" in ids and "UBERON:0000178" in ids
+    assert ids == sorted(ids)
+    page = store.filtering_terms(limit=2, skip=1)
+    assert len(page) == 2 and page[0]["id"] == ids[1]
+
+
+def test_sample_names_for_individual(store):
+    assert store.sample_names_for_individual("i1") == {"ds1": ["S0001"]}
+    assert store.sample_names_for_individual("i2") == {}
+
+
+def test_relations_survive_missing_links(store):
+    # ds3 has no individuals but must still appear in relations
+    rows = store.query(
+        "SELECT COUNT(*) FROM relations WHERE datasetid = 'ds3'"
+    )
+    assert rows[0][0] == 1
+
+
+def test_upsert_replaces_and_reindexes(store):
+    store.upsert(
+        "individuals",
+        [{"id": "i2", "datasetId": "ds1", "karyotypicSex": "XX",
+          "sex": {"id": "NCIT:C20197", "label": "male"}}],
+    )
+    f = [{"id": "karyotypicSex", "operator": "=", "value": "XX"}]
+    assert store.count("individuals", f) == 3
+
+
+def test_filter_errors():
+    with pytest.raises(FilterError):
+        entity_search_conditions([{"value": "x"}], "individuals", "individuals")
+    with pytest.raises(FilterError):
+        entity_search_conditions(
+            [{"id": "karyotypicSex", "operator": ">", "value": "XX"}],
+            "individuals",
+            "individuals",
+        )
+    with pytest.raises(FilterError):
+        entity_search_conditions([{"id": "x"}], "nonsense", "individuals")
+
+
+def test_sql_injection_resistant(store):
+    evil = [{"id": "karyotypicSex", "operator": "=",
+             "value": "x'; DROP TABLE individuals; --"}]
+    assert store.count("individuals", evil) == 0
+    assert store.count("individuals") == 3
+    evil2 = [{"id": "EVIL:'; DROP TABLE relations; --"}]
+    assert store.count("individuals", evil2) == 0
+
+
+def test_ontology_resolver_hook(onto):
+    calls = []
+
+    def resolver(term):
+        calls.append(term)
+        return {"MONDO:ROOT"}
+
+    onto.resolver = resolver
+    # unknown term -> resolver consulted, closure cached
+    assert onto.term_ancestors("MONDO:5") == {"MONDO:5", "MONDO:ROOT"}
+    assert onto.term_ancestors("MONDO:5") == {"MONDO:5", "MONDO:ROOT"}
+    assert calls == ["MONDO:5"]
+    # descendants updated from the registered ancestors
+    assert "MONDO:5" in onto.term_descendants("MONDO:ROOT")
+
+
+def test_numeric_filters_compare_numerically(store):
+    # TEXT storage must not fall back to lexicographic compare
+    store.upsert("cohorts", [
+        {"id": "c2", "name": "Big", "cohortSize": 1000},
+        {"id": "c3", "name": "Small", "cohortSize": 90},
+    ])
+    store.rebuild_indexes()
+    f = [{"id": "cohortSize", "operator": "<", "value": 200}]
+    assert [d["id"] for d in store.fetch("cohorts", f)] == ["c3"]
+    f = [{"id": "cohortSize", "operator": ">=", "value": 200}]
+    assert [d["id"] for d in store.fetch("cohorts", f)] == ["c2"]
+    # numeric '!' means !=
+    f = [{"id": "cohortSize", "operator": "!", "value": 90}]
+    assert "c3" not in [d["id"] for d in store.fetch("cohorts", f)]
