@@ -1,0 +1,433 @@
+"""The Beacon v2 application: one router over the full REST surface.
+
+Replaces the reference's API Gateway resource tree + 13 route lambdas
+(reference: api.tf + api-*.tf path parts; lambda/get*/lambda_function.py
+dispatchers) with a single in-process route table:
+
+    /  /info  /configuration  /map  /entry_types  /filtering_terms
+    /submit                          (POST new, PATCH update)
+    /{entity}                        x {datasets, cohorts, individuals,
+    /{entity}/filtering_terms           biosamples, runs, analyses}
+    /{entity}/{id}
+    /{entity}/{id}/{sub}             (cross-entity + scoped g_variants)
+    /g_variants  /g_variants/{id}  /g_variants/{id}/{biosamples,individuals}
+
+Every handler returns ``(status_code, body_dict)``; transport (HTTP server,
+tests, batch drivers) is external.
+"""
+
+from __future__ import annotations
+
+from ..config import BeaconConfig
+from ..engine import VariantEngine
+from ..ingest import IngestService
+from ..ingest.service import VcfLocationError
+from ..metadata import MetadataStore, OntologyStore
+from ..metadata.filters import FilterError
+from .envelopes import Envelopes
+from .framework import (
+    configuration_response,
+    entry_types_response,
+    info_response,
+    map_response,
+)
+from .requests import BeaconRequest, RequestError, parse_request
+from .submit import submit_dataset
+from .variants import (
+    decode_internal_id,
+    resolve_datasets,
+    run_variant_search,
+)
+
+ENTITY_PATHS = {
+    "datasets",
+    "cohorts",
+    "individuals",
+    "biosamples",
+    "runs",
+    "analyses",
+}
+
+_SET_TYPE = {
+    "datasets": "dataset",
+    "cohorts": "cohort",
+    "individuals": "individuals",
+    "biosamples": "biosamples",
+    "runs": "runs",
+    "analyses": "analyses",
+    "g_variants": "genomicVariant",
+}
+
+# {parent}/{id}/{child} metadata joins: child rows whose ``column`` = id
+_CROSS_ENTITY: dict[tuple[str, str], tuple[str, str]] = {
+    ("datasets", "individuals"): ("individuals", "_datasetid"),
+    ("datasets", "biosamples"): ("biosamples", "_datasetid"),
+    ("cohorts", "individuals"): ("individuals", "_cohortid"),
+    ("individuals", "biosamples"): ("biosamples", "individualid"),
+    ("biosamples", "analyses"): ("analyses", "biosampleid"),
+    ("biosamples", "runs"): ("runs", "biosampleid"),
+    ("runs", "analyses"): ("analyses", "runid"),
+}
+
+
+def strip_private(doc: dict) -> dict:
+    """Drop '_'-prefixed internal fields (reference jsons.dump
+    strip_privates=True on every record response)."""
+    return {k: v for k, v in doc.items() if not k.startswith("_")}
+
+
+class BeaconApp:
+    def __init__(
+        self,
+        config: BeaconConfig | None = None,
+        *,
+        store: MetadataStore | None = None,
+        ontology: OntologyStore | None = None,
+        engine: VariantEngine | None = None,
+        ingest: IngestService | None = None,
+    ):
+        self.config = config or BeaconConfig()
+        storage = self.config.storage
+        if ontology is None:
+            ontology = (
+                OntologyStore(storage.ontology_db)
+                if config is not None
+                else OntologyStore()
+            )
+        self.ontology = ontology
+        if store is None:
+            store = (
+                MetadataStore(storage.metadata_db, ontology=self.ontology)
+                if config is not None
+                else MetadataStore(ontology=self.ontology)
+            )
+        elif store.ontology is None:
+            store.ontology = self.ontology
+        self.store = store
+        self.engine = engine or VariantEngine(self.config)
+        self.ingest = ingest or IngestService(
+            self.config, engine=self.engine, store=self.store
+        )
+        self.env = Envelopes(self.config.info)
+
+    # -- transport-facing entry --------------------------------------------
+
+    def handle(
+        self,
+        method: str,
+        path: str,
+        query_params: dict | None = None,
+        body: dict | None = None,
+    ) -> tuple[int, dict]:
+        try:
+            return self._route(method.upper(), path, query_params, body)
+        except (RequestError, FilterError, VcfLocationError) as e:
+            return 400, self.env.error(400, str(e))
+        except Exception as e:  # pragma: no cover - defensive 500
+            return 500, self.env.error(500, f"{type(e).__name__}: {e}")
+
+    # -- routing ------------------------------------------------------------
+
+    def _route(self, method, path, query_params, body):
+        parts = [p for p in path.strip("/").split("/") if p]
+        info = self.config.info
+
+        if not parts or parts == ["info"]:
+            return 200, info_response(info)
+        head = parts[0]
+        if len(parts) == 1:
+            if head == "configuration":
+                return 200, configuration_response(info)
+            if head == "map":
+                return 200, map_response(info)
+            if head == "entry_types":
+                return 200, entry_types_response(info)
+            if head == "filtering_terms":
+                req = parse_request(method, query_params, body)
+                terms = self.store.filtering_terms(
+                    skip=req.skip, limit=req.limit
+                )
+                return 200, self.env.filtering_terms(
+                    terms, skip=req.skip, limit=req.limit
+                )
+            if head == "submit":
+                if method not in ("POST", "PATCH"):
+                    return 400, self.env.error(
+                        400, "submit accepts POST (new) or PATCH (update)"
+                    )
+                summary = submit_dataset(
+                    self, body or {}, update=(method == "PATCH")
+                )
+                return 200, summary
+
+        req = parse_request(method, query_params, body)
+
+        if head == "g_variants":
+            return self._route_g_variants(parts, req)
+        if head in ENTITY_PATHS:
+            return self._route_entity(parts, req)
+        return 404, self.env.error(404, f"unknown path /{'/'.join(parts)}")
+
+    # -- entity routes -------------------------------------------------------
+
+    def _route_entity(self, parts: list[str], req: BeaconRequest):
+        kind = parts[0]
+        if len(parts) == 1:
+            return self._entity_collection(kind, req)
+        if len(parts) == 2:
+            if parts[1] == "filtering_terms":
+                terms = self.store.filtering_terms(
+                    skip=req.skip, limit=req.limit, kinds=[kind]
+                )
+                return 200, self.env.filtering_terms(
+                    terms, skip=req.skip, limit=req.limit
+                )
+            return self._entity_by_id(kind, parts[1], req)
+        if len(parts) == 3:
+            entity_id, sub = parts[1], parts[2]
+            if sub == "filtering_terms" and kind in ("datasets", "cohorts"):
+                terms = self.store.filtering_terms_for_entity(
+                    kind, entity_id, skip=req.skip, limit=req.limit
+                )
+                return 200, self.env.filtering_terms(
+                    terms, skip=req.skip, limit=req.limit
+                )
+            if sub == "g_variants" and kind != "cohorts":
+                # cohorts expose no g_variants endpoint (reference api
+                # tree: cohort endpoints are {id}/individuals only)
+                return self._scoped_g_variants(kind, entity_id, req)
+            join = _CROSS_ENTITY.get((kind, sub))
+            if join is not None:
+                child_kind, column = join
+                return self._entity_collection(
+                    child_kind,
+                    req,
+                    extra_where=f"{column} = ?",
+                    extra_params=[entity_id],
+                )
+        return 404, self.env.error(404, f"unknown path /{'/'.join(parts)}")
+
+    def _entity_collection(
+        self,
+        kind: str,
+        req: BeaconRequest,
+        *,
+        extra_where: str | None = None,
+        extra_params: list | None = None,
+    ):
+        """Granularity switch over the store (reference route_individuals.py
+        :86-111 get_bool/count/record_query trio)."""
+        count = self.store.count(
+            kind,
+            req.filters,
+            extra_where=extra_where,
+            extra_params=extra_params,
+        )
+        if req.granularity == "boolean":
+            return 200, self.env.boolean(exists=count > 0)
+        if req.granularity == "count":
+            return 200, self.env.count(exists=count > 0, count=count)
+        docs = self.store.fetch(
+            kind,
+            req.filters,
+            skip=req.skip,
+            limit=req.limit,
+            extra_where=extra_where,
+            extra_params=extra_params,
+        )
+        return 200, self.env.result_sets(
+            results=[strip_private(d) for d in docs],
+            set_type=_SET_TYPE[kind],
+            exists=count > 0,
+            total=count,
+            skip=req.skip,
+            limit=req.limit,
+        )
+
+    def _entity_by_id(self, kind: str, entity_id: str, req: BeaconRequest):
+        doc = self.store.get_by_id(kind, entity_id)
+        results = [strip_private(doc)] if doc else []
+        if req.granularity == "boolean":
+            return 200, self.env.boolean(exists=bool(doc))
+        if req.granularity == "count":
+            return 200, self.env.count(exists=bool(doc), count=len(results))
+        return 200, self.env.result_sets(
+            results=results,
+            set_type=_SET_TYPE[kind],
+            exists=bool(doc),
+            total=len(results),
+        )
+
+    # -- variant routes ------------------------------------------------------
+
+    def _route_g_variants(self, parts: list[str], req: BeaconRequest):
+        if len(parts) == 1:
+            return self._g_variants_collection(req)
+        variant_id = parts[1]
+        if len(parts) == 2:
+            return self._g_variants_by_id(variant_id, req)
+        if len(parts) == 3 and parts[2] in ("biosamples", "individuals"):
+            return self._g_variants_id_entities(variant_id, parts[2], req)
+        return 404, self.env.error(404, f"unknown path /{'/'.join(parts)}")
+
+    def _g_variants_collection(self, req: BeaconRequest):
+        """POST/GET /g_variants (reference route_g_variants.py:49-208)."""
+        start_min, start_max, end_min, end_max = req.coordinates()
+        datasets, samples = resolve_datasets(
+            self.store, self.ontology, req.assembly_id, req.filters
+        )
+        agg = run_variant_search(
+            self.engine,
+            datasets,
+            req,
+            start_min=start_min,
+            start_max=start_max,
+            end_min=end_min,
+            end_max=end_max,
+            samples_by_dataset=samples,
+        )
+        return 200, self.env.by_granularity(
+            req.granularity,
+            exists=agg.exists,
+            count=len(agg.variants),
+            results=agg.results,
+            set_type="genomicVariant",
+            skip=req.skip,
+            limit=req.limit,
+        )
+
+    def _g_variants_by_id(self, variant_id: str, req: BeaconRequest):
+        """/g_variants/{id}: decode the internal id back into a point query
+        (reference route_g_variants_id.py:71-77); resultsets always ALL."""
+        assembly, chrom, pos0, ref, alt = decode_internal_id(variant_id)
+        req.assembly_id = assembly
+        datasets, samples = resolve_datasets(
+            self.store, self.ontology, assembly, req.filters
+        )
+        agg = run_variant_search(
+            self.engine,
+            datasets,
+            req,
+            start_min=pos0 + 1,
+            start_max=pos0 + 1,
+            end_min=pos0 + 1,
+            end_max=pos0 + len(alt) + 1,
+            reference_name=chrom,
+            reference_bases=ref,
+            alternate_bases=alt,
+            samples_by_dataset=samples,
+            include_resultset_responses="ALL",
+        )
+        return 200, self.env.by_granularity(
+            req.granularity,
+            exists=agg.exists,
+            count=len(agg.variants),
+            results=agg.results,
+            set_type="genomicVariant",
+        )
+
+    def _g_variants_id_entities(
+        self, variant_id: str, sub: str, req: BeaconRequest
+    ):
+        """/g_variants/{id}/{biosamples,individuals}: find the samples
+        carrying the variant, then join to the entity table (reference
+        route_g_variants_id_individuals.py get_record_query)."""
+        assembly, chrom, pos0, ref, alt = decode_internal_id(variant_id)
+        req.assembly_id = assembly
+        datasets, _ = resolve_datasets(
+            self.store, self.ontology, assembly, req.filters
+        )
+        # force record granularity internally so sample hits materialise
+        inner = BeaconRequest(
+            method=req.method,
+            granularity="record",
+            filters=req.filters,
+            assembly_id=assembly,
+        )
+        agg = run_variant_search(
+            self.engine,
+            datasets,
+            inner,
+            start_min=pos0 + 1,
+            start_max=pos0 + 1,
+            end_min=pos0 + 1,
+            end_max=pos0 + len(alt) + 1,
+            reference_name=chrom,
+            reference_bases=ref,
+            alternate_bases=alt,
+            include_resultset_responses="ALL",
+        )
+        docs: list[dict] = []
+        for ds_id, names in sorted(agg.sample_names_by_dataset.items()):
+            docs.extend(
+                self.store.entities_for_samples(
+                    sub, ds_id, names, skip=0, limit=1_000_000_000
+                )
+            )
+        count = len(docs)
+        return 200, self.env.by_granularity(
+            req.granularity,
+            exists=count > 0,
+            count=count,
+            results=[
+                strip_private(d)
+                for d in docs[req.skip : req.skip + req.limit]
+            ],
+            set_type=_SET_TYPE[sub],
+            skip=req.skip,
+            limit=req.limit,
+        )
+
+    def _scoped_g_variants(self, kind: str, entity_id: str, req: BeaconRequest):
+        """/{entity}/{id}/g_variants — the entity-restricted variant search
+        (reference route_individuals_id_g_variants.py etc.): datasets come
+        from the entity's analyses join and the search runs in
+        selected-samples mode; /datasets/{id}/g_variants restricts by
+        dataset id only."""
+        start_min, start_max, end_min, end_max = req.coordinates()
+        if kind == "datasets":
+            datasets, samples = resolve_datasets(
+                self.store,
+                self.ontology,
+                req.assembly_id,
+                req.filters,
+                dataset_ids=[entity_id],
+            )
+        else:
+            samples = {
+                "individuals": self.store.sample_names_for_individual,
+                "biosamples": self.store.sample_names_for_biosample,
+                "runs": self.store.sample_names_for_run,
+                "analyses": self.store.sample_names_for_analysis,
+            }[kind](entity_id)
+            if not samples:
+                return 200, self.env.by_granularity(
+                    req.granularity, exists=False, count=0, results=[]
+                )
+            datasets, _ = resolve_datasets(
+                self.store,
+                self.ontology,
+                req.assembly_id,
+                req.filters,
+                dataset_ids=sorted(samples),
+            )
+            datasets = [d for d in datasets if samples.get(d["id"])]
+        agg = run_variant_search(
+            self.engine,
+            datasets,
+            req,
+            start_min=start_min,
+            start_max=start_max,
+            end_min=end_min,
+            end_max=end_max,
+            samples_by_dataset=samples,
+        )
+        return 200, self.env.by_granularity(
+            req.granularity,
+            exists=agg.exists,
+            count=len(agg.variants),
+            results=agg.results,
+            set_type="genomicVariant",
+            skip=req.skip,
+            limit=req.limit,
+        )

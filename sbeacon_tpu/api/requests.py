@@ -1,0 +1,180 @@
+"""Beacon v2 request parsing + validation.
+
+One parser for the GET/POST duality every reference route re-implements
+(reference: each route's paired ``if event['httpMethod'] == 'GET'/'POST'``
+blocks, e.g. getGenomicVariants/route_g_variants.py:50-116): GET flattens
+query parameters (comma-joined filters/start/end), POST nests them under
+``meta`` / ``query.requestParameters`` / ``query.pagination``.
+
+Also owns the Beacon start/end coordinate interpretation — the 1- vs
+2-element bracket forms and the 0->1-based ``+1`` dance (reference:
+shared_resources/variantutils/search_variants.py:48-68).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+class RequestError(ValueError):
+    """400-worthy request problem; message is user-facing."""
+
+
+def _int(value, name: str, default: int | None = None) -> int:
+    if value is None or value == "":
+        if default is None:
+            raise RequestError(f"{name} must be specified")
+        return default
+    try:
+        return int(value)
+    except (TypeError, ValueError):
+        raise RequestError(f"{name} must be an integer") from None
+
+
+def _int_list(value, name: str) -> list[int]:
+    if value is None:
+        return []
+    if isinstance(value, str):
+        parts = [p for p in value.split(",") if p != ""]
+    elif isinstance(value, (list, tuple)):
+        parts = list(value)
+    else:
+        parts = [value]
+    try:
+        return [int(p) for p in parts]
+    except (TypeError, ValueError):
+        raise RequestError(f"{name} must be a list of integers") from None
+
+
+def _parse_filters(raw) -> list[dict]:
+    """GET form 'A,B' -> [{'id': 'A'}, {'id': 'B'}]; POST form passes
+    through the filter dicts."""
+    if raw is None:
+        return []
+    if isinstance(raw, str):
+        return [{"id": fid} for fid in raw.split(",") if fid]
+    if isinstance(raw, list):
+        out = []
+        for f in raw:
+            if isinstance(f, str):
+                out.append({"id": f})
+            elif isinstance(f, dict):
+                if "id" not in f:
+                    raise RequestError("filter missing 'id'")
+                out.append(f)
+            else:
+                raise RequestError("filters must be strings or objects")
+        return out
+    raise RequestError("filters must be a list or comma-joined string")
+
+
+@dataclass
+class BeaconRequest:
+    """Normalised request: both HTTP methods collapse into this."""
+
+    method: str = "GET"
+    granularity: str = "boolean"
+    skip: int = 0
+    limit: int = 100
+    filters: list[dict] = field(default_factory=list)
+    include_resultset_responses: str = "NONE"
+    # g_variants request parameters
+    start: list[int] = field(default_factory=list)
+    end: list[int] = field(default_factory=list)
+    assembly_id: str | None = None
+    reference_name: str | None = None
+    reference_bases: str | None = None
+    alternate_bases: str | None = None
+    variant_type: str | None = None
+    variant_min_length: int = 0
+    variant_max_length: int = -1
+
+    def coordinates(self) -> tuple[int, int, int, int]:
+        """(start_min, start_max, end_min, end_max), 1-based inclusive.
+
+        The exact bracket interpretation + the '+1' conversion of
+        reference search_variants.py:48-68: a 2-element start/end is a
+        bracket range; 1-element start with 1-element end is a
+        start-anchored range whose end list bounds the variant end.
+        """
+        start, end = self.start, self.end
+        if not start:
+            raise RequestError("start must be specified")
+        if len(start) > 2 or len(end) > 2:
+            raise RequestError("start and end accept at most 2 values")
+        if len(start) == 2:
+            start_min, start_max = start
+        else:
+            start_min = start[0]
+        if len(end) == 2:
+            end_min, end_max = end
+        elif len(end) == 1:
+            end_min = start_min
+            end_max = end[0]
+        else:
+            raise RequestError("end must be specified")
+        if len(start) != 2:
+            start_max = end_max
+        return start_min + 1, start_max + 1, end_min + 1, end_max + 1
+
+
+def parse_request(
+    method: str,
+    query_params: dict | None,
+    body: dict | None,
+) -> BeaconRequest:
+    req = BeaconRequest(method=method.upper())
+    if req.method == "POST":
+        params = body or {}
+        query = params.get("query") or {}
+        pagination = query.get("pagination") or {}
+        rp = query.get("requestParameters") or {}
+        req.granularity = query.get("requestedGranularity", "boolean")
+        req.skip = _int(pagination.get("skip"), "skip", 0)
+        req.limit = _int(pagination.get("limit"), "limit", 100)
+        req.filters = _parse_filters(query.get("filters"))
+        req.include_resultset_responses = query.get(
+            "includeResultsetResponses", "NONE"
+        )
+        req.start = _int_list(rp.get("start"), "start")
+        req.end = _int_list(rp.get("end"), "end")
+        req.assembly_id = rp.get("assemblyId")
+        req.reference_name = rp.get("referenceName")
+        req.reference_bases = rp.get("referenceBases")
+        req.alternate_bases = rp.get("alternateBases")
+        req.variant_type = rp.get("variantType")
+        req.variant_min_length = _int(
+            rp.get("variantMinLength"), "variantMinLength", 0
+        )
+        req.variant_max_length = _int(
+            rp.get("variantMaxLength"), "variantMaxLength", -1
+        )
+    else:
+        params = query_params or {}
+        req.granularity = params.get("requestedGranularity", "boolean")
+        req.skip = _int(params.get("skip"), "skip", 0)
+        req.limit = _int(params.get("limit"), "limit", 100)
+        req.filters = _parse_filters(params.get("filters"))
+        req.include_resultset_responses = params.get(
+            "includeResultsetResponses", "NONE"
+        )
+        req.start = _int_list(params.get("start"), "start")
+        req.end = _int_list(params.get("end"), "end")
+        req.assembly_id = params.get("assemblyId")
+        req.reference_name = params.get("referenceName")
+        req.reference_bases = params.get("referenceBases")
+        req.alternate_bases = params.get("alternateBases")
+        req.variant_type = params.get("variantType")
+        req.variant_min_length = _int(
+            params.get("variantMinLength"), "variantMinLength", 0
+        )
+        req.variant_max_length = _int(
+            params.get("variantMaxLength"), "variantMaxLength", -1
+        )
+    if req.granularity not in ("boolean", "count", "record", "aggregated"):
+        raise RequestError(
+            f"unknown requestedGranularity {req.granularity!r}"
+        )
+    if req.skip < 0 or req.limit < 0:
+        raise RequestError("skip and limit must be non-negative")
+    return req

@@ -1,0 +1,83 @@
+"""Threaded stdlib HTTP transport for BeaconApp.
+
+The reference's API Gateway + AWS_PROXY integration layer (reference:
+api.tf REST resources, stage 'prod') reduced to one ThreadingHTTPServer:
+URL + query string + JSON body in, JSON out, CORS header kept
+(reference apiutils/api_response.py HEADERS).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from .app import BeaconApp
+
+
+def _make_handler(app: BeaconApp):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, *args):  # quiet by default
+            pass
+
+        def _respond(self):
+            parsed = urlparse(self.path)
+            # flatten single-valued query params (API-GW style)
+            query = {
+                k: (v[0] if len(v) == 1 else ",".join(v))
+                for k, v in parse_qs(parsed.query).items()
+            }
+            body = None
+            length = int(self.headers.get("Content-Length") or 0)
+            if length:
+                raw = self.rfile.read(length)
+                try:
+                    body = json.loads(raw)
+                except json.JSONDecodeError:
+                    self._send(400, {"error": "invalid JSON body"})
+                    return
+            status, payload = app.handle(
+                self.command, parsed.path, query, body
+            )
+            self._send(status, payload)
+
+        def _send(self, status: int, payload: dict):
+            data = json.dumps(payload).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        do_GET = _respond
+        do_POST = _respond
+        do_PATCH = _respond
+
+    return Handler
+
+
+def make_server(app: BeaconApp, host: str = "127.0.0.1", port: int = 0):
+    """ThreadingHTTPServer bound to (host, port); port 0 picks a free one."""
+    return ThreadingHTTPServer((host, port), _make_handler(app))
+
+
+def serve(app: BeaconApp, host: str = "0.0.0.0", port: int = 5000):
+    """Blocking serve-forever (the deployment entry)."""
+    server = make_server(app, host, port)
+    try:
+        server.serve_forever()
+    finally:
+        server.server_close()
+
+
+def start_background(app: BeaconApp, host: str = "127.0.0.1", port: int = 0):
+    """(server, thread) with the server running on a daemon thread —
+    used by tests and the benchmark harness."""
+    server = make_server(app, host, port)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    return server, t

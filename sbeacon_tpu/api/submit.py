@@ -1,0 +1,143 @@
+"""Dataset submission: POST/PATCH /submit.
+
+The reference's submitDataset lambda (reference: lambda/submitDataset/
+lambda_function.py:191-261 submit_dataset/update_dataset + :79-188
+create_dataset): validate against a JSON Schema, verify every VCF is
+reachable and indexed, write the dataset + chromosome map, fan the metadata
+entities out to the store, and optionally kick the indexer. Here the
+summarisation pipeline hook replaces the commented-out SNS kick
+(reference :216-218 — wired unconditionally, as SURVEY.md directs).
+"""
+
+from __future__ import annotations
+
+import jsonschema
+
+from ..metadata import ENTITY_KINDS  # noqa: F401  (re-export convenience)
+from .requests import RequestError
+
+_ENTITY_ARRAY = {"type": "array", "items": {"type": "object"}}
+
+# compact schema with the same required surface as the reference's
+# submitDataset-schema-new.json / -update.json pair
+SUBMIT_SCHEMA_NEW = {
+    "type": "object",
+    "properties": {
+        "datasetId": {"type": "string", "minLength": 1},
+        "assemblyId": {"type": "string", "minLength": 1},
+        "vcfLocations": {
+            "type": "array",
+            "items": {"type": "string", "minLength": 1},
+        },
+        "vcfGroups": {
+            "type": "array",
+            "items": {"type": "array", "items": {"type": "string"}},
+        },
+        "dataset": {"type": "object"},
+        "cohortId": {"type": "string"},
+        "cohort": {"type": "object"},
+        "individuals": _ENTITY_ARRAY,
+        "biosamples": _ENTITY_ARRAY,
+        "runs": _ENTITY_ARRAY,
+        "analyses": _ENTITY_ARRAY,
+        "index": {"type": "boolean"},
+    },
+    "required": ["datasetId", "assemblyId", "vcfLocations", "dataset"],
+    "additionalProperties": False,
+}
+
+SUBMIT_SCHEMA_UPDATE = {
+    **SUBMIT_SCHEMA_NEW,
+    "required": ["datasetId"],
+}
+
+
+def validate_submission(body: dict, *, update: bool) -> None:
+    schema = SUBMIT_SCHEMA_UPDATE if update else SUBMIT_SCHEMA_NEW
+    validator = jsonschema.Draft7Validator(schema)
+    errors = sorted(validator.iter_errors(body), key=lambda e: e.path)
+    if errors:
+        raise RequestError(
+            "; ".join(e.message for e in errors[:5])
+        )
+
+
+def submit_dataset(
+    app,
+    body: dict,
+    *,
+    update: bool = False,
+) -> dict:
+    """Validate and ingest one submission; returns the progress summary."""
+    if not isinstance(body, dict):
+        raise RequestError("body must be a JSON object")
+    validate_submission(body, update=update)
+
+    dataset_id = body["datasetId"]
+    cohort_id = body.get("cohortId")
+    completed: list[str] = []
+    pending: list[str] = []
+
+    existing = app.store.get_by_id("datasets", dataset_id) if update else None
+
+    vcf_locations = body.get("vcfLocations", [])
+    # VCF reachability + chromosome map (reference check_vcf_locations
+    # :48-76 + get_vcf_chromosomes); delegated to the ingestion layer so
+    # the API has no direct file-format knowledge
+    chrom_map = []
+    if vcf_locations:
+        chrom_map = app.ingest.check_vcf_locations(vcf_locations)
+        completed.append("Verified VCF locations")
+    elif existing:
+        # PATCH without vcfLocations keeps the registered VCFs
+        vcf_locations = existing.get("_vcfLocations", [])
+        chrom_map = existing.get("_vcfChromosomeMap", [])
+
+    if body.get("dataset") is not None or (
+        existing and body.get("vcfLocations")
+    ):
+        # a PATCH carrying only new vcfLocations must still land them on
+        # the stored doc, else they verify but never persist/summarise
+        doc = dict(existing or {})
+        doc.update(body.get("dataset") or {})
+        doc["id"] = dataset_id
+        doc["_assemblyId"] = body.get(
+            "assemblyId",
+            (existing or {}).get("_assemblyId", "UNKNOWN"),
+        )
+        doc["_vcfLocations"] = vcf_locations
+        doc["_vcfChromosomeMap"] = chrom_map
+        app.store.upsert("datasets", [doc])
+        completed.append("Added dataset metadata")
+
+    if cohort_id and body.get("cohort") is not None:
+        doc = dict(body["cohort"])
+        doc["id"] = cohort_id
+        app.store.upsert("cohorts", [doc])
+        completed.append("Added cohorts")
+
+    if dataset_id:
+        # the reference drops these silently without a cohortId
+        # (lambda_function.py:122 gates on both); here a dataset-only
+        # submission still lands its entities, with _cohortId left unset
+        for kind in ("individuals", "biosamples", "runs", "analyses"):
+            docs = body.get(kind, [])
+            if not docs:
+                continue
+            for doc in docs:
+                doc["_datasetId"] = dataset_id
+                if cohort_id:
+                    doc["_cohortId"] = cohort_id
+            app.store.upsert(kind, list(docs))
+            completed.append(f"Added {kind}")
+
+    if body.get("index", False):
+        app.store.rebuild_indexes()
+        completed.append("Rebuilt indexes")
+
+    # ingestion pipeline kick (unconditional, unlike the reference's
+    # commented-out SNS publish)
+    if vcf_locations:
+        pending.extend(app.ingest.schedule_summarisation(dataset_id))
+
+    return {"completed": completed, "pending": pending}

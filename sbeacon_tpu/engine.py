@@ -25,6 +25,8 @@ shape caps and byte-exact (blob, not hash) allele comparison.
 
 from __future__ import annotations
 
+import logging
+
 import numpy as np
 
 from .config import BeaconConfig
@@ -47,11 +49,15 @@ def _blob_eq(
     *,
     upper: bool,
     prefix: bool = False,
+    wildcard_n: bool = False,
 ) -> np.ndarray:
     """Vectorised per-row compare of blob slices against one query string.
 
     Equality mode: row bytes (uppercased when ``upper``) == want.
     Prefix mode: row starts with ``want``.
+    Wildcard mode: an 'N' in ``want`` accepts any of A/C/G/T/N at that
+    position (the selected-samples ref regex, reference
+    search_variants_in_samples.py:87-91).
     No per-row Python: rows are first narrowed by length, then compared as a
     2D fixed-width gather.
     """
@@ -68,12 +74,21 @@ def _blob_eq(
     if upper:
         mat = _UPPER[mat]
     wanted = np.frombuffer(want, dtype=np.uint8)
-    out[cand] = (mat == wanted).all(axis=1)
+    eq = mat == wanted
+    if wildcard_n:
+        acgtn = np.isin(mat, np.frombuffer(b"ACGTN", dtype=np.uint8))
+        eq |= (wanted == ord("N")) & acgtn
+    out[cand] = eq.all(axis=1)
     return out
 
 
-def host_match_rows(shard: VariantIndexShard, q: QuerySpec) -> np.ndarray:
-    """All matching row ids, numpy-vectorised, no caps, byte-exact alleles."""
+def host_match_rows(
+    shard: VariantIndexShard, q: QuerySpec, *, ref_wildcard: bool = False
+) -> np.ndarray:
+    """All matching row ids, numpy-vectorised, no caps, byte-exact alleles.
+
+    ``ref_wildcard`` switches the ref compare to the selected-samples
+    N-wildcard semantics."""
     c = shard.cols
     code = chromosome_code(q.chrom)
     lo = int(shard.chrom_offsets[code])
@@ -99,6 +114,7 @@ def host_match_rows(shard: VariantIndexShard, q: QuerySpec) -> np.ndarray:
             c["ref_len"][sl],
             q.reference_bases.encode(),
             upper=True,
+            wildcard_n=ref_wildcard,
         )
 
     alt_len = c["alt_len"][sl]
@@ -157,6 +173,10 @@ def host_match_rows(shard: VariantIndexShard, q: QuerySpec) -> np.ndarray:
     return idx[ok]
 
 
+def _popcount_masked(plane_row: np.ndarray, mask: np.ndarray) -> int:
+    return sum(int(w).bit_count() for w in (plane_row & mask))
+
+
 def materialize_response(
     shard: VariantIndexShard,
     rows: np.ndarray,
@@ -165,12 +185,44 @@ def materialize_response(
     chrom_label: str,
     dataset_id: str = "",
     vcf_location: str = "",
+    selected_idx: list[int] | None = None,
 ) -> VariantSearchResponse:
-    """Row ids -> VariantSearchResponse with cumulative-order semantics."""
+    """Row ids -> VariantSearchResponse with cumulative-order semantics.
+
+    ``selected_idx`` activates the selected-samples leaf (reference
+    search_variants_in_samples.py): INFO-sourced AC/AN stay full-cohort
+    (bcftools --samples leaves INFO untouched) while genotype-derived
+    counts, variant listing and sample-hit extraction are restricted to the
+    masked samples; returned sample indices are positions in the *selected*
+    list, as the subset bcftools output would yield.
+    """
     c = shard.cols
     rows = np.asarray(rows, dtype=np.int64)
     granularity = payload.requested_granularity
     include_details = payload.include_details
+
+    mask = None
+    if selected_idx is not None and shard.gt_bits is not None:
+        mask = np.zeros(shard.gt_bits.shape[1], dtype=np.uint32)
+        for si in selected_idx:
+            mask[si // 32] |= np.uint32(1 << (si % 32))
+    # restricted genotype-derived counting needs the full plane set; a
+    # shard persisted before the count planes existed degrades to the
+    # full-cohort baked counts (sample extraction still restricts)
+    count_planes = (
+        mask is not None
+        and shard.gt_bits2 is not None
+        and shard.tok_bits1 is not None
+        and shard.tok_bits2 is not None
+    )
+    sel_set = set(selected_idx or [])
+
+    def _overflow_extra(which: str, row: int) -> int:
+        return sum(
+            v - 2
+            for s, v in shard.overflow_map(which).get(row, ())
+            if s in sel_set
+        )
 
     exists = False
     call_count = 0
@@ -189,11 +241,21 @@ def materialize_response(
         rec_rows = rows[i:j]
         i = j
 
-        rec_call = int(c["ac"][rec_rows].sum())
-        call_count += rec_call
         for r in rec_rows:
-            if c["ac"][r] != 0:
-                variants.append(shard.variant_string(int(r), chrom_label))
+            r = int(r)
+            if count_planes and not (c["flags"][r] & FLAG.AC_INFO):
+                rc = (
+                    _popcount_masked(shard.gt_bits[r], mask)
+                    + _popcount_masked(shard.gt_bits2[r], mask)
+                    + _overflow_extra("gt", r)
+                )
+                call_count += rc
+                if rc:
+                    variants.append(shard.variant_string(r, chrom_label))
+            else:
+                call_count += int(c["ac"][r])
+                if c["ac"][r] != 0:
+                    variants.append(shard.variant_string(r, chrom_label))
 
         if call_count:
             exists = True
@@ -205,9 +267,25 @@ def materialize_response(
                 and shard.gt_bits is not None
             ):
                 for r in rec_rows:
-                    sample_indices.update(shard.row_samples(int(r)))
+                    if mask is None:
+                        sample_indices.update(shard.row_samples(int(r)))
+                    else:
+                        bits = shard.gt_bits[int(r)]
+                        sample_indices.update(
+                            k
+                            for k, si in enumerate(selected_idx)
+                            if bits[si // 32] >> np.uint32(si % 32) & 1
+                        )
 
-        all_alleles += int(c["an"][rec_rows[0]])
+        r0 = int(rec_rows[0])
+        if count_planes and not (c["flags"][r0] & FLAG.AN_INFO):
+            all_alleles += (
+                _popcount_masked(shard.tok_bits1[r0], mask)
+                + _popcount_masked(shard.tok_bits2[r0], mask)
+                + _overflow_extra("tok", r0)
+            )
+        else:
+            all_alleles += int(c["an"][r0])
 
         if granularity == "boolean" and exists:
             break
@@ -219,6 +297,8 @@ def materialize_response(
         and shard.meta.get("sample_names")
     ):
         names = shard.meta["sample_names"]
+        if selected_idx is not None:
+            names = [names[si] for si in selected_idx]
         resolved = [s for k, s in enumerate(names) if k in sample_indices]
 
     return VariantSearchResponse(
@@ -249,7 +329,20 @@ class VariantEngine:
 
     def add_index(self, shard: VariantIndexShard) -> None:
         key = (shard.meta.get("dataset_id", ""), shard.meta.get("vcf_location", ""))
-        self._indexes[key] = (shard, DeviceIndex(shard))
+        try:
+            dindex = DeviceIndex(shard)
+        except Exception:
+            # accelerator unavailable (backend init failure, OOM): serve
+            # from the host matcher instead of failing ingestion/queries —
+            # query serving must not depend on one specific compute
+            # resource. Full traceback is logged so programming errors in
+            # DeviceIndex are not silently downgraded.
+            logging.getLogger(__name__).exception(
+                "device index unavailable for %s; serving host-only",
+                key,
+            )
+            dindex = None
+        self._indexes[key] = (shard, dindex)
 
     def datasets(self) -> list[str]:
         return sorted({ds for ds, _ in self._indexes})
@@ -291,16 +384,31 @@ class VariantEngine:
 
         responses = []
         for ds, vcf, shard, dindex, native in targets:
-            res = run_queries(
-                dindex,
-                [spec_base],
-                window_cap=eng.window_cap,
-                record_cap=eng.record_cap,
-            )
-            if res.overflow[0] or res.n_matched[0] > eng.record_cap:
+            selected_idx = None
+            if payload.selected_samples_only:
+                # selected-samples leaf (reference performQuery/
+                # lambda_function.py:43-46 switches to
+                # search_variants_in_samples): host path, sample-restricted
+                wanted = payload.sample_names.get(ds, [])
+                universe = shard.meta.get("sample_names", [])
+                name_to_idx = {s: k for k, s in enumerate(universe)}
+                selected_idx = [
+                    name_to_idx[s] for s in wanted if s in name_to_idx
+                ]
+                rows = host_match_rows(shard, spec_base, ref_wildcard=True)
+            elif dindex is None:
                 rows = host_match_rows(shard, spec_base)
             else:
-                rows = res.rows[0][res.rows[0] >= 0]
+                res = run_queries(
+                    dindex,
+                    [spec_base],
+                    window_cap=eng.window_cap,
+                    record_cap=eng.record_cap,
+                )
+                if res.overflow[0] or res.n_matched[0] > eng.record_cap:
+                    rows = host_match_rows(shard, spec_base)
+                else:
+                    rows = res.rows[0][res.rows[0] >= 0]
             responses.append(
                 materialize_response(
                     shard,
@@ -309,6 +417,7 @@ class VariantEngine:
                     chrom_label=native,
                     dataset_id=ds,
                     vcf_location=vcf,
+                    selected_idx=selected_idx,
                 )
             )
         return responses

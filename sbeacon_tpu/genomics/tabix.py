@@ -232,6 +232,51 @@ def list_chromosomes(vcf_path: str | Path) -> list[str]:
     return seen
 
 
+def write_tbi(idx: TabixIndex, path: str | Path) -> None:
+    """Serialise a TabixIndex to the on-disk .tbi format (BGZF-wrapped,
+    SAM/tabix spec layout — the inverse of ``parse_tbi``)."""
+    out = bytearray()
+    out += b"TBI\x01"
+    out += struct.pack("<i", len(idx.names))
+    out += struct.pack(
+        "<6i",
+        idx.fmt,
+        idx.col_seq,
+        idx.col_beg,
+        idx.col_end,
+        idx.meta_char,
+        idx.skip,
+    )
+    names_blob = b"".join(n.encode() + b"\x00" for n in idx.names)
+    out += struct.pack("<i", len(names_blob))
+    out += names_blob
+    for ref in idx.refs:
+        out += struct.pack("<i", len(ref.bins))
+        for bin_no in sorted(ref.bins):
+            chunks = ref.bins[bin_no]
+            out += struct.pack("<Ii", bin_no, len(chunks))
+            for ck in chunks:
+                out += struct.pack("<QQ", ck.beg, ck.end)
+        out += struct.pack("<i", len(ref.linear))
+        out += struct.pack(f"<{len(ref.linear)}Q", *ref.linear)
+    from .bgzf import BgzfWriter
+
+    with BgzfWriter(path) as w:
+        w.write(bytes(out))
+
+
+def ensure_index(vcf_path: str | Path) -> TabixIndex:
+    """Parse the existing .tbi/.csi, or self-index the VCF and persist the
+    result (the framework's replacement for requiring external ``tabix``
+    runs before submission)."""
+    idx = find_index_for(vcf_path)
+    if idx is not None:
+        return idx
+    idx = build_tbi(vcf_path)
+    write_tbi(idx, str(vcf_path) + ".tbi")
+    return idx
+
+
 def build_tbi(vcf_path: str | Path) -> TabixIndex:
     """Build a tabix-equivalent index in memory by scanning the VCF.
 

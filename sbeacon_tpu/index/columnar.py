@@ -51,6 +51,8 @@ class FLAG:
     DEL_PREFIX = 64  # alt starts with '<DEL'
     DUP_PREFIX = 128  # alt starts with '<DUP'
     SINGLE_BASE = 256  # alt.upper() in {A,C,G,T,N}
+    AC_INFO = 512  # row's ac came from INFO AC (not genotype tally)
+    AN_INFO = 1024  # row's an came from INFO AN (not genotype tally)
 
 
 def fnv1a32(data: bytes) -> int:
@@ -146,6 +148,33 @@ class VariantIndexShard:
     alt_off: np.ndarray
     vt_codes: np.ndarray  # int16[n] into meta['vt_vocab']
     gt_bits: np.ndarray | None = None  # uint32[n, ceil(n_samples/32)]
+    # extra genotype planes for the selected-samples restricted path
+    # (reference search_variants_in_samples.py genotype-derived counting):
+    # gt_bits2 — sample carries >=2 copies of the row's alt;
+    # tok_bits1/tok_bits2 — sample's GT has >=1/>=2 numeric allele tokens
+    # (per record, duplicated across its alt rows).
+    gt_bits2: np.ndarray | None = None
+    tok_bits1: np.ndarray | None = None
+    tok_bits2: np.ndarray | None = None
+    # exact values where the 2-bit planes saturate (ploidy > 2):
+    # int64[k, 3] rows of (row, sample, copies) / (row, sample, tokens)
+    gt_overflow: np.ndarray | None = None
+    tok_overflow: np.ndarray | None = None
+
+    def overflow_map(self, which: str) -> dict[int, list[tuple[int, int]]]:
+        """{row: [(sample, exact_value), ...]} for 'gt' or 'tok' overflow
+        entries; cached."""
+        attr = f"_{which}_overflow_map"
+        cached = getattr(self, attr, None)
+        if cached is not None:
+            return cached
+        arr = self.gt_overflow if which == "gt" else self.tok_overflow
+        out: dict[int, list[tuple[int, int]]] = {}
+        if arr is not None:
+            for row, sample, value in arr.tolist():
+                out.setdefault(int(row), []).append((int(sample), int(value)))
+        object.__setattr__(self, attr, out)
+        return out
 
     @property
     def n_rows(self) -> int:
@@ -242,6 +271,11 @@ def build_index(
     gt_bits = (
         np.zeros((n, gt_words), dtype=np.uint32) if gt_words else None
     )
+    gt_bits2 = np.zeros_like(gt_bits) if gt_bits is not None else None
+    tok_bits1 = np.zeros_like(gt_bits) if gt_bits is not None else None
+    tok_bits2 = np.zeros_like(gt_bits) if gt_bits is not None else None
+    gt_overflow: list[tuple[int, int, int]] = []
+    tok_overflow: list[tuple[int, int, int]] = []
     ref_parts: list[bytes] = []
     alt_parts: list[bytes] = []
     chrom_offsets = np.zeros(N_CHROM_CODES + 1, dtype=np.int32)
@@ -268,7 +302,11 @@ def build_index(
         cols["ref_hash"][i] = fnv1a32(ref.upper().encode())
         cols["alt_hash"][i] = fnv1a32(alt.upper().encode())
         cols["ref_repeat_k"][i] = _ref_repeat_k(ref, alt)
-        cols["flags"][i] = _alt_flags(alt)
+        cols["flags"][i] = (
+            _alt_flags(alt)
+            | (FLAG.AC_INFO if rec.ac is not None else 0)
+            | (FLAG.AN_INFO if rec.an is not None else 0)
+        )
         cols["ac"][i] = ac_cache[rec_ord][alt_ord]
         cols["an"][i] = an_cache[rec_ord]
         cols["rec_id"][i] = rec_renumber[rec_ord]
@@ -286,8 +324,21 @@ def build_index(
                 ]
             allele = alt_ord + 1
             for s_idx, toks in enumerate(calls_cache[rec_ord]):
-                if allele in toks:
-                    gt_bits[i, s_idx // 32] |= np.uint32(1 << (s_idx % 32))
+                bit = np.uint32(1 << (s_idx % 32))
+                w = s_idx // 32
+                copies = toks.count(allele)
+                if copies >= 1:
+                    gt_bits[i, w] |= bit
+                if copies >= 2:
+                    gt_bits2[i, w] |= bit
+                if copies > 2:  # ploidy > 2: keep the exact count
+                    gt_overflow.append((i, s_idx, copies))
+                if len(toks) >= 1:
+                    tok_bits1[i, w] |= bit
+                if len(toks) >= 2:
+                    tok_bits2[i, w] |= bit
+                if len(toks) > 2:
+                    tok_overflow.append((i, s_idx, len(toks)))
 
     # chrom offsets: chrom_offsets[c] = first row of code c
     codes = np.array([r[0] for r in rows], dtype=np.int32)
@@ -328,6 +379,19 @@ def build_index(
         alt_off=alt_off,
         vt_codes=vt_codes,
         gt_bits=gt_bits,
+        gt_bits2=gt_bits2,
+        tok_bits1=tok_bits1,
+        tok_bits2=tok_bits2,
+        gt_overflow=(
+            np.array(gt_overflow, dtype=np.int64).reshape(-1, 3)
+            if gt_bits is not None
+            else None
+        ),
+        tok_overflow=(
+            np.array(tok_overflow, dtype=np.int64).reshape(-1, 3)
+            if gt_bits is not None
+            else None
+        ),
     )
     return shard
 
@@ -349,8 +413,17 @@ def save_index(shard: VariantIndexShard, path: str | Path) -> None:
     arrays["alt_blob"] = shard.alt_blob
     arrays["alt_off"] = shard.alt_off
     arrays["vt_codes"] = shard.vt_codes
-    if shard.gt_bits is not None:
-        arrays["gt_bits"] = shard.gt_bits
+    for plane in (
+        "gt_bits",
+        "gt_bits2",
+        "tok_bits1",
+        "tok_bits2",
+        "gt_overflow",
+        "tok_overflow",
+    ):
+        arr = getattr(shard, plane)
+        if arr is not None:
+            arrays[plane] = arr
     np.savez_compressed(path, **arrays)
     Path(str(path) + ".meta.json").write_text(json.dumps(shard.meta))
 
@@ -371,7 +444,17 @@ def load_index(path: str | Path) -> VariantIndexShard:
         alt_blob=data["alt_blob"],
         alt_off=data["alt_off"],
         vt_codes=data["vt_codes"],
-        gt_bits=data["gt_bits"] if "gt_bits" in data.files else None,
+        **{
+            plane: (data[plane] if plane in data.files else None)
+            for plane in (
+                "gt_bits",
+                "gt_bits2",
+                "tok_bits1",
+                "tok_bits2",
+                "gt_overflow",
+                "tok_overflow",
+            )
+        },
     )
 
 
@@ -444,9 +527,36 @@ def merge_shards(shards: list[VariantIndexShard]) -> VariantIndexShard:
     same_samples = all(
         s.meta["sample_names"] == shards[0].meta["sample_names"] for s in shards
     )
-    gt_bits = None
-    if same_samples and all(s.gt_bits is not None for s in shards):
-        gt_bits = np.concatenate([s.gt_bits for s in shards])[order]
+    planes: dict[str, np.ndarray | None] = {}
+    for plane in ("gt_bits", "gt_bits2", "tok_bits1", "tok_bits2"):
+        planes[plane] = None
+        if same_samples and all(
+            getattr(s, plane) is not None for s in shards
+        ):
+            planes[plane] = np.concatenate(
+                [getattr(s, plane) for s in shards]
+            )[order]
+    # overflow side-tables: remap old per-shard rows to merged positions
+    inv_order = np.empty(n, dtype=np.int64)
+    inv_order[order] = np.arange(n)
+    row_base = np.cumsum([0] + [s.n_rows for s in shards[:-1]])
+    for plane in ("gt_overflow", "tok_overflow"):
+        planes[plane] = None
+        if same_samples and all(
+            getattr(s, plane) is not None for s in shards
+        ):
+            parts = []
+            for base, s in zip(row_base, shards):
+                arr = getattr(s, plane)
+                if len(arr):
+                    remapped = arr.copy()
+                    remapped[:, 0] = inv_order[arr[:, 0] + base]
+                    parts.append(remapped)
+            planes[plane] = (
+                np.concatenate(parts)
+                if parts
+                else np.zeros((0, 3), dtype=np.int64)
+            )
 
     # blobs: offset each shard's row ids into the concatenated blob space
     ref_blob_cat = np.concatenate([s.ref_blob for s in shards])
@@ -517,5 +627,5 @@ def merge_shards(shards: list[VariantIndexShard]) -> VariantIndexShard:
         alt_blob=alt_blob,
         alt_off=alt_off,
         vt_codes=vt_codes,
-        gt_bits=gt_bits,
+        **planes,
     )

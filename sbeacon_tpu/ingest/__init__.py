@@ -1,0 +1,3 @@
+from .service import IngestService
+
+__all__ = ["IngestService"]

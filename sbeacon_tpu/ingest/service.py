@@ -1,0 +1,112 @@
+"""Ingestion service: VCF validation + summarisation into index shards.
+
+Replaces the reference's submit-side VCF machinery — the ``tabix``
+reachability probe (reference: lambda/submitDataset/lambda_function.py:
+48-76 check_vcf_locations, shared_resources/utils/chrom_matching.py:43-61
+get_vcf_chromosomes) and the SNS summarisation pipeline entry
+(summariseDataset -> summariseVcf -> summariseSlice) — with direct calls
+into the genomics layer. The scheduled path currently summarises
+synchronously; the resumable job-ledger pipeline builds on this surface.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from ..config import BeaconConfig
+from ..genomics.tabix import ensure_index, list_chromosomes
+from ..genomics.vcf import iter_vcf_records, read_sample_names
+from ..index.columnar import build_index, load_index, save_index
+from ..utils.chrom import get_matching_chromosome  # noqa: F401 (API parity)
+
+
+class VcfLocationError(ValueError):
+    """A submitted VCF is missing or unindexed (400 at the API boundary)."""
+
+
+class IngestService:
+    def __init__(self, config: BeaconConfig | None = None, *, engine=None, store=None):
+        self.config = config or BeaconConfig()
+        self.engine = engine
+        self.store = store
+
+    # -- submission-time checks --------------------------------------------
+
+    def check_vcf_locations(self, vcf_locations: list[str]) -> list[dict]:
+        """Probe every VCF; returns the chromosome map entries the dataset
+        doc carries (reference VcfChromosomeMap items {vcf, chromosomes})."""
+        chrom_map = []
+        errors = []
+        for vcf in set(vcf_locations):
+            p = Path(vcf)
+            if not p.exists():
+                errors.append(f"Could not find file {vcf}")
+                continue
+            try:
+                # self-index when no .tbi/.csi accompanies the file —
+                # unlike the reference, submission does not require an
+                # external ``tabix`` run
+                ensure_index(p)
+                chroms = list_chromosomes(p)
+            except Exception as e:
+                errors.append(f"Could not index {vcf}: {e}")
+                continue
+            chrom_map.append({"vcf": str(vcf), "chromosomes": chroms})
+        if errors:
+            raise VcfLocationError("; ".join(sorted(errors)))
+        # keep submission order for the map
+        order = {e["vcf"]: e for e in chrom_map}
+        return [order[v] for v in dict.fromkeys(vcf_locations)]
+
+    # -- summarisation ------------------------------------------------------
+
+    def _shard_path(self, dataset_id: str, vcf: str) -> Path:
+        safe = str(vcf).replace("/", "%")
+        return self.config.storage.index_dir / dataset_id / f"{safe}.npz"
+
+    def summarise_vcf(self, dataset_id: str, vcf: str):
+        """Build (or reload) the columnar index shard for one VCF."""
+        path = self._shard_path(dataset_id, vcf)
+        if path.exists():
+            return load_index(path)
+        sample_names = read_sample_names(vcf)
+        records = list(iter_vcf_records(vcf))
+        shard = build_index(
+            records,
+            dataset_id=dataset_id,
+            vcf_location=str(vcf),
+            sample_names=sample_names,
+        )
+        save_index(shard, path)
+        return shard
+
+    def schedule_summarisation(self, dataset_id: str) -> list[str]:
+        """Summarise every VCF of the dataset and pin shards to the engine.
+
+        Synchronous equivalent of the reference's SNS pipeline kick; returns
+        progress messages for the submit response.
+        """
+        if self.store is None:
+            return []
+        doc = self.store.get_by_id("datasets", dataset_id)
+        if doc is None:
+            return []
+        messages = []
+        for vcf in doc.get("_vcfLocations", []):
+            shard = self.summarise_vcf(dataset_id, vcf)
+            if self.engine is not None:
+                self.engine.add_index(shard)
+            messages.append(f"Summarised {vcf}")
+        return messages
+
+    def load_all(self) -> int:
+        """Re-pin every persisted shard (startup / crash-resume); returns
+        the number of shards loaded."""
+        n = 0
+        idx_dir = self.config.storage.index_dir
+        if not idx_dir.exists() or self.engine is None:
+            return 0
+        for path in sorted(idx_dir.glob("*/*.npz")):
+            self.engine.add_index(load_index(path))
+            n += 1
+        return n

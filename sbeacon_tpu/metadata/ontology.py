@@ -32,7 +32,9 @@ class OntologyStore:
     def __init__(self, path: str | Path = ":memory:"):
         if path != ":memory:":
             Path(path).parent.mkdir(parents=True, exist_ok=True)
-        self.conn = sqlite3.connect(str(path))
+        # served from HTTP worker threads; sqlite objects are guarded by
+        # the GIL for our single-statement usage
+        self.conn = sqlite3.connect(str(path), check_same_thread=False)
         self.conn.executescript(
             """
             CREATE TABLE IF NOT EXISTS ontologies (

@@ -203,8 +203,22 @@ class MetadataStore:
         rows = self.conn.execute(sql, [*params, limit, skip]).fetchall()
         return [json.loads(r[0]) for r in rows]
 
-    def count(self, kind: str, filters: list[dict] | None = None) -> int:
+    def count(
+        self,
+        kind: str,
+        filters: list[dict] | None = None,
+        *,
+        extra_where: str | None = None,
+        extra_params: list | None = None,
+    ) -> int:
         where, params = self._compile(filters or [], kind)
+        if extra_where:
+            where = (
+                f"{where} AND {extra_where}"
+                if where
+                else f"WHERE {extra_where}"
+            )
+            params = params + list(extra_params or [])
         sql = f"SELECT COUNT(*) FROM {kind} {where}"
         return int(self.conn.execute(sql, params).fetchone()[0])
 
@@ -291,6 +305,67 @@ class MetadataStore:
         self, biosample_id: str
     ) -> dict[str, list[str]]:
         return self._sample_names_via_analyses("biosampleid", biosample_id)
+
+    def sample_names_for_run(self, run_id: str) -> dict[str, list[str]]:
+        return self._sample_names_via_analyses("runid", run_id)
+
+    def sample_names_for_analysis(
+        self, analysis_id: str
+    ) -> dict[str, list[str]]:
+        return self._sample_names_via_analyses("id", analysis_id)
+
+    def filtering_terms_for_entity(
+        self, kind: str, entity_id: str, *, skip: int = 0, limit: int = 100
+    ) -> list[dict]:
+        """Terms attached to one dataset/cohort and every entity under it
+        (reference route_datasets_id_filtering_terms.py:83-127 — the
+        5-way UNION over the entity's own terms and its child entities)."""
+        fk = "_datasetid" if kind == "datasets" else "_cohortid"
+        union = [
+            "SELECT term FROM terms_index WHERE id = ? AND kind = ?"
+        ]
+        params: list = [entity_id, kind]
+        for child in ("individuals", "biosamples", "runs", "analyses"):
+            union.append(
+                f"SELECT TI.term FROM {child} E "
+                f"JOIN terms_index TI ON TI.id = E.id "
+                f"AND TI.kind = '{child}' WHERE E.{fk} = ?"
+            )
+            params.append(entity_id)
+        rows = self.conn.execute(
+            "SELECT DISTINCT term, label, type FROM terms WHERE term IN "
+            f"({' UNION '.join(union)}) ORDER BY term LIMIT ? OFFSET ?",
+            [*params, limit, skip],
+        ).fetchall()
+        return [{"id": t, "label": lb, "type": ty} for t, lb, ty in rows]
+
+    def entities_for_samples(
+        self,
+        kind: str,
+        dataset_id: str,
+        sample_names: list[str],
+        *,
+        skip: int = 0,
+        limit: int = 100,
+    ) -> list[dict]:
+        """Entities of ``kind`` whose analyses carry one of the VCF sample
+        names in a dataset (reference route_g_variants_id_individuals.py
+        get_record_query: individuals JOIN analyses ON individualid WHERE
+        _vcfsampleid IN samples)."""
+        join_col = {"individuals": "individualid", "biosamples": "biosampleid"}[
+            kind
+        ]
+        if not sample_names:
+            return []
+        ph = ", ".join("?" for _ in sample_names)
+        rows = self.conn.execute(
+            f"SELECT DISTINCT E._doc FROM {kind} E "
+            f"JOIN analyses A ON A.{join_col} = E.id "
+            f"WHERE A._datasetid = ? AND A._vcfsampleid IN ({ph}) "
+            f"ORDER BY E.id LIMIT ? OFFSET ?",
+            [dataset_id, *sample_names, limit, skip],
+        ).fetchall()
+        return [json.loads(r[0]) for r in rows]
 
     def close(self) -> None:
         self.conn.close()

@@ -152,6 +152,16 @@ def _alt_hits(
     ]
 
 
+def _subset_genotypes(record: VcfRecord, idx: list[int]) -> VcfRecord:
+    """Copy of the record with GT columns subset to ``idx``, in that order
+    (what ``bcftools query --samples a,b`` emits)."""
+    import dataclasses
+
+    return dataclasses.replace(
+        record, genotypes=[record.genotypes[i] for i in idx]
+    )
+
+
 def match_record(
     record: VcfRecord,
     *,
@@ -165,12 +175,22 @@ def match_record(
     variant_min_length: int = 0,
     variant_max_length: int = -1,
     chrom_label: str | None = None,
+    selected_sample_idx: list[int] | None = None,
 ) -> MatchResult | None:
     """Apply the per-record filter chain; None when the record is rejected.
 
     Mirrors the loop body of perform_query (reference :70-250): window
     ownership, end-range, ref validation, alt dispatch, AC/AN-vs-genotype
     counting duality.
+
+    ``selected_sample_idx`` switches to the selected-samples leaf
+    (reference: performQuery/search_variants_in_samples.py — the
+    ``bcftools query --samples`` path): the genotype columns are subset to
+    those sample indexes (INFO AC/AN stay full-cohort, exactly as bcftools
+    leaves INFO untouched), genotype-derived counting and sample-hit
+    extraction run over the subset, and the ref check becomes the
+    N-wildcard regex (``reference_bases.replace('N', '[ACGTN]{1}')``,
+    search_variants_in_samples.py:87-91).
     """
     out = MatchResult()
     pos = record.pos
@@ -182,8 +202,17 @@ def match_record(
         return None
 
     approx = reference_bases is None or reference_bases == "N"
-    if not approx and record.ref.upper() != reference_bases:
-        return None
+    if selected_sample_idx is None:
+        if not approx and record.ref.upper() != reference_bases:
+            return None
+    else:
+        if not approx:
+            rgx = re.compile(
+                "^" + reference_bases.replace("N", "[ACGTN]{1}") + "$"
+            )
+            if not rgx.match(record.ref.upper()):
+                return None
+        record = _subset_genotypes(record, selected_sample_idx)
 
     max_len = float("inf") if variant_max_length < 0 else variant_max_length
     hit_indexes = _alt_hits(
@@ -252,6 +281,7 @@ def oracle_search(
     dataset_id: str = "",
     vcf_location: str = "",
     chrom_label: str | None = None,
+    selected_sample_idx: list[int] | None = None,
 ) -> VariantSearchResponse:
     """Full scan over records, reference accumulator semantics included.
 
@@ -279,6 +309,7 @@ def oracle_search(
             variant_min_length=variant_min_length,
             variant_max_length=variant_max_length,
             chrom_label=chrom_label,
+            selected_sample_idx=selected_sample_idx,
         )
         if m is None:
             continue

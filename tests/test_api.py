@@ -1,0 +1,422 @@
+"""End-to-end Beacon v2 API surface tests: submit -> query through
+BeaconApp.handle() and over real HTTP."""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from sbeacon_tpu.api import BeaconApp
+from sbeacon_tpu.api.server import start_background
+from sbeacon_tpu.config import BeaconConfig, StorageConfig
+from sbeacon_tpu.genomics.tabix import ensure_index
+from sbeacon_tpu.genomics.vcf import write_vcf
+from sbeacon_tpu.testing import random_records
+
+SAMPLES = [f"S{i}" for i in range(6)]
+SEX_TERMS = ["NCIT:C16576", "NCIT:C20197"]  # female, male
+
+
+def _submission(dataset_id, cohort_id, vcf, sex_of):
+    individuals = [
+        {
+            "id": f"I{i}",
+            "sex": {"id": sex_of(i), "label": "-"},
+            "diseases": [{"diseaseCode": {"id": f"HP:000{i % 2}"}}],
+        }
+        for i in range(len(SAMPLES))
+    ]
+    biosamples = [
+        {
+            "id": f"B{i}",
+            "individualId": f"I{i}",
+            "biosampleStatus": {"id": "EFO:0009654", "label": "reference"},
+        }
+        for i in range(len(SAMPLES))
+    ]
+    runs = [
+        {"id": f"R{i}", "biosampleId": f"B{i}", "individualId": f"I{i}"}
+        for i in range(len(SAMPLES))
+    ]
+    analyses = [
+        {
+            "id": f"A{i}",
+            "runId": f"R{i}",
+            "biosampleId": f"B{i}",
+            "individualId": f"I{i}",
+            "vcfSampleId": SAMPLES[i],
+        }
+        for i in range(len(SAMPLES))
+    ]
+    return {
+        "datasetId": dataset_id,
+        "assemblyId": "GRCh38",
+        "vcfLocations": [str(vcf)],
+        "dataset": {"name": dataset_id, "description": "test"},
+        "cohortId": cohort_id,
+        "cohort": {"name": f"cohort-{dataset_id}"},
+        "individuals": individuals,
+        "biosamples": biosamples,
+        "runs": runs,
+        "analyses": analyses,
+        "index": True,
+    }
+
+
+@pytest.fixture(scope="module")
+def app(tmp_path_factory):
+    root = tmp_path_factory.mktemp("beacon")
+    rng = random.Random(5)
+    recs = random_records(
+        rng, chrom="22", n=120, n_samples=len(SAMPLES), p_no_acan=0.3
+    )
+    vcf = root / "ds1.vcf.gz"
+    write_vcf(vcf, recs, sample_names=SAMPLES)
+    ensure_index(vcf)
+
+    config = BeaconConfig(storage=StorageConfig(root=root / "data"))
+    config.storage.ensure()
+    app = BeaconApp(config)
+    status, body = app.handle(
+        "POST",
+        "/submit",
+        body=_submission(
+            "ds1", "c1", vcf, lambda i: SEX_TERMS[i % 2]
+        ),
+    )
+    assert status == 200, body
+    app._test_records = recs
+    return app
+
+
+def test_framework_endpoints(app):
+    for path in ("/", "/info", "/configuration", "/map", "/entry_types"):
+        status, body = app.handle("GET", path)
+        assert status == 200
+        assert body["meta"]["beaconId"] == app.config.info.beacon_id
+        assert "response" in body
+    # map endpoint sets cover all 7 entry types
+    _, m = app.handle("GET", "/map")
+    assert len(m["response"]["endpointSets"]) == 7
+
+
+def test_filtering_terms(app):
+    status, body = app.handle("GET", "/filtering_terms")
+    assert status == 200
+    terms = body["response"]["filteringTerms"]
+    ids = {t["id"] for t in terms}
+    assert "NCIT:C16576" in ids and "HP:0000" in ids
+    # entity-kind scoped
+    _, body = app.handle("GET", "/individuals/filtering_terms")
+    ids = {t["id"] for t in body["response"]["filteringTerms"]}
+    assert "NCIT:C16576" in ids
+    # dataset-id scoped
+    _, body = app.handle("GET", "/datasets/ds1/filtering_terms")
+    ids = {t["id"] for t in body["response"]["filteringTerms"]}
+    assert "HP:0000" in ids
+
+
+def test_entity_collections(app):
+    _, body = app.handle("GET", "/individuals", {"requestedGranularity": "count"})
+    assert body["responseSummary"] == {
+        "exists": True,
+        "numTotalResults": len(SAMPLES),
+    }
+    _, body = app.handle(
+        "GET", "/individuals", {"requestedGranularity": "record", "limit": "3"}
+    )
+    rs = body["response"]["resultSets"][0]
+    assert rs["resultsCount"] == 3
+    assert all(not k.startswith("_") for r in rs["results"] for k in r)
+    # POST with ontology filter: sex=female hits the even individuals
+    _, body = app.handle(
+        "POST",
+        "/individuals",
+        body={
+            "query": {
+                "requestedGranularity": "count",
+                "filters": [{"id": "NCIT:C16576"}],
+            }
+        },
+    )
+    assert body["responseSummary"]["numTotalResults"] == 3
+    # boolean
+    _, body = app.handle("GET", "/cohorts")
+    assert body["responseSummary"]["exists"] is True
+
+
+def test_entity_by_id_and_cross_entity(app):
+    _, body = app.handle(
+        "GET", "/individuals/I0", {"requestedGranularity": "record"}
+    )
+    assert body["response"]["resultSets"][0]["results"][0]["id"] == "I0"
+    _, body = app.handle(
+        "GET",
+        "/datasets/ds1/individuals",
+        {"requestedGranularity": "count"},
+    )
+    assert body["responseSummary"]["numTotalResults"] == len(SAMPLES)
+    _, body = app.handle(
+        "GET",
+        "/individuals/I2/biosamples",
+        {"requestedGranularity": "record"},
+    )
+    assert [r["id"] for r in body["response"]["resultSets"][0]["results"]] == [
+        "B2"
+    ]
+    _, body = app.handle(
+        "GET", "/biosamples/B3/runs", {"requestedGranularity": "record"}
+    )
+    assert [r["id"] for r in body["response"]["resultSets"][0]["results"]] == [
+        "R3"
+    ]
+    _, body = app.handle(
+        "GET", "/runs/R1/analyses", {"requestedGranularity": "record"}
+    )
+    assert [r["id"] for r in body["response"]["resultSets"][0]["results"]] == [
+        "A1"
+    ]
+    _, body = app.handle(
+        "GET", "/cohorts/c1/individuals", {"requestedGranularity": "count"}
+    )
+    assert body["responseSummary"]["numTotalResults"] == len(SAMPLES)
+    # unknown id
+    _, body = app.handle("GET", "/individuals/NOPE")
+    assert body["responseSummary"]["exists"] is False
+
+
+def _hit_query(app, granularity="boolean", include="NONE"):
+    """A query guaranteed to hit: first record with nonzero AC."""
+    rec = next(
+        r
+        for r in app._test_records
+        if sum(r.effective_ac()) > 0 and not r.alts[0].startswith("<")
+    )
+    return rec, {
+        "query": {
+            "requestedGranularity": granularity,
+            "includeResultsetResponses": include,
+            "requestParameters": {
+                "assemblyId": "GRCh38",
+                "referenceName": "22",
+                "start": [rec.pos - 1],
+                "end": [rec.pos],
+                "referenceBases": rec.ref.upper(),
+                "alternateBases": rec.alts[0].upper(),
+            },
+        }
+    }
+
+
+def test_g_variants_boolean_and_record(app):
+    rec, q = _hit_query(app)
+    status, body = app.handle("POST", "/g_variants", body=q)
+    assert status == 200
+    assert body["responseSummary"]["exists"] is True
+
+    _, q = _hit_query(app, "record", "HIT")
+    _, body = app.handle("POST", "/g_variants", body=q)
+    results = body["response"]["resultSets"][0]["results"]
+    assert results, body
+    entry = results[0]
+    assert entry["variation"]["referenceBases"] == rec.ref
+    # miss query
+    miss = {
+        "query": {
+            "requestedGranularity": "boolean",
+            "requestParameters": {
+                "assemblyId": "GRCh38",
+                "referenceName": "21",
+                "start": [5],
+                "end": [6],
+                "alternateBases": "T",
+            },
+        }
+    }
+    _, body = app.handle("POST", "/g_variants", body=miss)
+    assert body["responseSummary"]["exists"] is False
+
+
+def test_g_variants_get_form(app):
+    rec, _ = _hit_query(app)
+    _, body = app.handle(
+        "GET",
+        "/g_variants",
+        {
+            "assemblyId": "GRCh38",
+            "referenceName": "22",
+            "start": str(rec.pos - 1),
+            "end": str(rec.pos),
+            "referenceBases": rec.ref.upper(),
+            "alternateBases": rec.alts[0].upper(),
+            "requestedGranularity": "count",
+            # count tallies the variants set only under HIT/ALL — the
+            # reference's check_all gate (route_g_variants.py:160-168)
+            "includeResultsetResponses": "HIT",
+        },
+    )
+    assert body["responseSummary"]["exists"] is True
+    assert body["responseSummary"]["numTotalResults"] >= 1
+
+
+def test_g_variants_id_roundtrip(app):
+    rec, q = _hit_query(app, "record", "HIT")
+    _, body = app.handle("POST", "/g_variants", body=q)
+    vid = body["response"]["resultSets"][0]["results"][0][
+        "variantInternalId"
+    ]
+    _, body = app.handle(
+        "GET", f"/g_variants/{vid}", {"requestedGranularity": "boolean"}
+    )
+    assert body["responseSummary"]["exists"] is True
+    # carriers of the variant
+    _, body = app.handle(
+        "GET",
+        f"/g_variants/{vid}/individuals",
+        {"requestedGranularity": "record"},
+    )
+    rs = body["response"]["resultSets"][0]
+    carrier_ids = {r["id"] for r in rs["results"]}
+    # oracle: samples whose GT carries alt 1 of that record
+    want = {
+        f"I{i}"
+        for i, gt in enumerate(rec.genotypes)
+        if any(t == "1" for t in gt.replace("|", "/").split("/"))
+    }
+    if want:
+        assert carrier_ids == want
+    _, body = app.handle(
+        "GET",
+        f"/g_variants/{vid}/biosamples",
+        {"requestedGranularity": "count"},
+    )
+    assert body["responseSummary"]["numTotalResults"] == len(want)
+
+
+def test_scoped_g_variants(app):
+    """/individuals/{id}/g_variants returns exists consistent with the
+    individual's genotypes."""
+    recs = app._test_records
+    # individual I0: find a record where S0 carries alt 1
+    rec = next(
+        r
+        for r in recs
+        if any(t == "1" for t in r.genotypes[0].replace("|", "/").split("/"))
+        and not r.alts[0].startswith("<")
+    )
+    q = {
+        "query": {
+            "requestedGranularity": "boolean",
+            "requestParameters": {
+                "assemblyId": "GRCh38",
+                "referenceName": "22",
+                "start": [rec.pos - 1],
+                "end": [rec.pos],
+                "alternateBases": rec.alts[0].upper(),
+            },
+        }
+    }
+    _, body = app.handle("POST", "/individuals/I0/g_variants", body=q)
+    assert body["responseSummary"]["exists"] is True
+    _, body = app.handle("POST", "/datasets/ds1/g_variants", body=q)
+    assert body["responseSummary"]["exists"] is True
+    _, body = app.handle("POST", "/analyses/A0/g_variants", body=q)
+    assert body["responseSummary"]["exists"] is True
+    _, body = app.handle("POST", "/runs/R0/g_variants", body=q)
+    assert body["responseSummary"]["exists"] is True
+    _, body = app.handle("POST", "/biosamples/B0/g_variants", body=q)
+    assert body["responseSummary"]["exists"] is True
+    # an individual that does NOT carry it
+    non = next(
+        (
+            i
+            for i, gt in enumerate(rec.genotypes)
+            if not any(
+                t == "1" for t in gt.replace("|", "/").split("/")
+            )
+        ),
+        None,
+    )
+    if non is not None and rec.ac is None:
+        _, body = app.handle(
+            "POST", f"/individuals/I{non}/g_variants", body=q
+        )
+        assert body["responseSummary"]["exists"] is False
+
+
+def test_patch_preserves_wiring(app):
+    """PATCH /submit with only new dataset metadata must not wipe the
+    dataset's assembly/VCF wiring."""
+    s, b = app.handle(
+        "PATCH",
+        "/submit",
+        body={"datasetId": "ds1", "dataset": {"name": "renamed"}},
+    )
+    assert s == 200, b
+    doc = app.store.get_by_id("datasets", "ds1")
+    assert doc["name"] == "renamed"
+    assert doc["_assemblyId"] == "GRCh38"
+    assert doc["_vcfLocations"], doc
+    # variant queries still resolve the dataset
+    rec, q = _hit_query(app)
+    _, body = app.handle("POST", "/g_variants", body=q)
+    assert body["responseSummary"]["exists"] is True
+
+
+def test_submit_without_cohort_keeps_entities(app, tmp_path):
+    """Dataset-only submission (no cohortId) still lands its entities."""
+    s, b = app.handle(
+        "POST",
+        "/submit",
+        body={
+            "datasetId": "ds-solo",
+            "assemblyId": "GRCh38",
+            "vcfLocations": [],
+            "dataset": {"name": "solo"},
+            "individuals": [{"id": "SOLO-I", "sex": {"id": "NCIT:C20197"}}],
+        },
+    )
+    assert s == 200, b
+    assert "Added individuals" in b["completed"]
+    doc = app.store.get_by_id("individuals", "SOLO-I")
+    assert doc["_datasetId"] == "ds-solo"
+    app.store.delete("individuals", "SOLO-I")
+    app.store.delete("datasets", "ds-solo")
+
+
+def test_errors(app):
+    status, body = app.handle("POST", "/g_variants", body={"query": {}})
+    assert status == 400 and "error" in body
+    status, body = app.handle("GET", "/nope")
+    assert status == 404
+    status, body = app.handle("POST", "/submit", body={"datasetId": "x"})
+    assert status == 400
+    status, body = app.handle(
+        "GET", "/individuals", {"requestedGranularity": "bogus"}
+    )
+    assert status == 400
+
+
+def test_http_server_roundtrip(app):
+    server, _ = start_background(app)
+    port = server.server_address[1]
+    base = f"http://127.0.0.1:{port}"
+    try:
+        with urllib.request.urlopen(f"{base}/info", timeout=10) as r:
+            assert r.status == 200
+            body = json.loads(r.read())
+            assert body["response"]["id"] == app.config.info.beacon_id
+        rec, q = _hit_query(app)
+        req = urllib.request.Request(
+            f"{base}/g_variants",
+            data=json.dumps(q).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+            assert body["responseSummary"]["exists"] is True
+    finally:
+        server.shutdown()
+        server.server_close()
