@@ -18,7 +18,9 @@ tests, batch drivers) is external.
 
 from __future__ import annotations
 
-from ..config import BeaconConfig
+from pathlib import Path
+
+from ..config import BeaconConfig, StorageConfig
 from ..engine import VariantEngine
 from ..ingest import IngestService
 from ..ingest.service import VcfLocationError
@@ -86,19 +88,32 @@ class BeaconApp:
         engine: VariantEngine | None = None,
         ingest: IngestService | None = None,
     ):
-        self.config = config or BeaconConfig()
+        if config is None:
+            # configless (ad hoc / test) apps keep sqlite in memory and
+            # write index shards under a throwaway temp root, removed when
+            # the app is garbage-collected
+            import tempfile
+
+            config_given = False
+            self._tmp_root = tempfile.TemporaryDirectory(prefix="beacon-")
+            self.config = BeaconConfig(
+                storage=StorageConfig(root=Path(self._tmp_root.name))
+            )
+        else:
+            config_given = True
+            self.config = config
         storage = self.config.storage
         if ontology is None:
             ontology = (
                 OntologyStore(storage.ontology_db)
-                if config is not None
+                if config_given
                 else OntologyStore()
             )
         self.ontology = ontology
         if store is None:
             store = (
                 MetadataStore(storage.metadata_db, ontology=self.ontology)
-                if config is not None
+                if config_given
                 else MetadataStore(ontology=self.ontology)
             )
         elif store.ontology is None:
@@ -290,7 +305,7 @@ class BeaconApp:
             req.granularity,
             exists=agg.exists,
             count=len(agg.variants),
-            results=agg.results,
+            results=agg.results[req.skip : req.skip + req.limit],
             set_type="genomicVariant",
             skip=req.skip,
             limit=req.limit,
@@ -426,7 +441,7 @@ class BeaconApp:
             req.granularity,
             exists=agg.exists,
             count=len(agg.variants),
-            results=agg.results,
+            results=agg.results[req.skip : req.skip + req.limit],
             set_type="genomicVariant",
             skip=req.skip,
             limit=req.limit,

@@ -53,6 +53,16 @@ def _make_handler(app: BeaconApp):
             self.end_headers()
             self.wfile.write(data)
 
+        def do_OPTIONS(self):  # CORS preflight
+            self.send_response(204)
+            self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header(
+                "Access-Control-Allow-Methods", "GET, POST, PATCH, OPTIONS"
+            )
+            self.send_header("Access-Control-Allow-Headers", "Content-Type")
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
         do_GET = _respond
         do_POST = _respond
         do_PATCH = _respond

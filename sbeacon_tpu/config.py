@@ -110,10 +110,28 @@ class EngineConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class IngestConfig:
+    """Slice-planning cost model (reference: summariseVcf constants
+    :21-25 and the ABS_MAX_DATA_SPLIT / VCF_S3_OUTPUT_SIZE_LIMIT terraform
+    ceilings, main.tf:16-17). The planner minimises total_time x cost over
+    slice size — here 'dispatch' is a thread-pool task instead of an SNS
+    message + lambda cold start, so the constants default far cheaper, but
+    the optimiser itself is the same math."""
+
+    min_task_time: float = 0.005  # MIN_SS_TIME (s)
+    scan_rate: float = 200_000_000  # SS_RATE (compressed B/s, host parse)
+    dispatch_cost: float = 0.0005  # SNS_TIME equivalent (s/task)
+    max_concurrency: int = 64  # MAX_CONCURRENCY
+    workers: int = 8  # parallel slice workers
+    max_range_bytes: int = 750 * 1024 * 1024  # ABS_MAX_DATA_SPLIT
+
+
+@dataclasses.dataclass(frozen=True)
 class BeaconConfig:
     info: BeaconInfo = dataclasses.field(default_factory=BeaconInfo)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
+    ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
 
     @staticmethod
     def from_env(root: str | os.PathLike | None = None) -> "BeaconConfig":

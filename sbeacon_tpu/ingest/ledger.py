@@ -1,0 +1,234 @@
+"""Resumable ingestion job ledger.
+
+Re-homes the reference's DynamoDB control tables (reference: dynamodb.tf —
+``VcfSummaries`` with its ``toUpdate`` string set, ``Datasets``
+``toUpdateFiles``, ``VariantDuplicates`` ``toUpdate`` ranges) into one
+sqlite database with the same checkpoint/resume semantics (SURVEY.md §5):
+the pending-work sets ARE the checkpoints. A crashed worker leaves its
+slice in ``to_update``; re-running the stage processes only what remains;
+counters are cleared on (re)start exactly as the reference REMOVEs the
+count attributes when marking a VCF updating
+(summariseVcf/lambda_function.py:159-186 mark_updating).
+
+Concurrency control uses sqlite's atomicity the way the reference uses
+DynamoDB conditional expressions: ``mark_updating`` is an INSERT that
+fails when a summarisation is already running
+(``attribute_not_exists(toUpdate)``), and ``complete_slice`` removes one
+slice and reports whether it was the last (the reference's atomic
+DELETE-from-set + last-deleter-advances-pipeline barrier,
+summariseSlice/main.cpp:360-438).
+"""
+
+from __future__ import annotations
+
+import json
+import sqlite3
+import threading
+import time
+from pathlib import Path
+
+
+def _slice_str(s: tuple[int, int]) -> str:
+    return f"{s[0]}-{s[1]}"
+
+
+class JobLedger:
+    def __init__(self, path: str | Path = ":memory:"):
+        if path != ":memory:":
+            Path(path).parent.mkdir(parents=True, exist_ok=True)
+        self.conn = sqlite3.connect(str(path), check_same_thread=False)
+        self._lock = threading.Lock()
+        self.conn.executescript(
+            """
+            CREATE TABLE IF NOT EXISTS vcf_summaries (
+                vcf_location TEXT PRIMARY KEY,
+                to_update TEXT,          -- JSON list of pending slice strings
+                variant_count INTEGER,
+                call_count INTEGER,
+                sample_count INTEGER,
+                updated_at REAL
+            );
+            CREATE TABLE IF NOT EXISTS dataset_jobs (
+                dataset_id TEXT PRIMARY KEY,
+                to_update_files TEXT,    -- JSON list of pending VCFs
+                variant_count INTEGER,   -- distinct across VCFs
+                call_count INTEGER,
+                sample_count INTEGER,
+                state TEXT,
+                updated_at REAL
+            );
+            """
+        )
+        self.conn.commit()
+
+    # -- VCF summarisation state (reference VcfSummaries table) -------------
+
+    def mark_updating(
+        self, vcf_location: str, slices: list[tuple[int, int]]
+    ) -> bool:
+        """Claim a VCF for summarisation; False when already in progress
+        (the reference's attribute_not_exists(toUpdate) condition)."""
+        pending = json.dumps([_slice_str(s) for s in slices])
+        with self._lock:
+            # BEGIN IMMEDIATE takes the write lock up front so the
+            # check-then-insert is atomic across *processes* sharing the
+            # ledger file, not just threads (the DynamoDB conditional-write
+            # equivalence the module docstring promises)
+            self.conn.execute("BEGIN IMMEDIATE")
+            try:
+                row = self.conn.execute(
+                    "SELECT to_update FROM vcf_summaries "
+                    "WHERE vcf_location = ?",
+                    (vcf_location,),
+                ).fetchone()
+                if (
+                    row is not None
+                    and row[0] is not None
+                    and json.loads(row[0])
+                ):
+                    self.conn.execute("ROLLBACK")
+                    return False
+                # counts cleared on (re)start, like the REMOVE of COUNTS
+                self.conn.execute(
+                    "INSERT OR REPLACE INTO vcf_summaries VALUES "
+                    "(?, ?, 0, 0, NULL, ?)",
+                    (vcf_location, pending, time.time()),
+                )
+                self.conn.execute("COMMIT")
+            except BaseException:
+                self.conn.execute("ROLLBACK")
+                raise
+        return True
+
+    def pending_slices(self, vcf_location: str) -> list[tuple[int, int]]:
+        row = self.conn.execute(
+            "SELECT to_update FROM vcf_summaries WHERE vcf_location = ?",
+            (vcf_location,),
+        ).fetchone()
+        if row is None or row[0] is None:
+            return []
+        out = []
+        for s in json.loads(row[0]):
+            a, b = s.split("-")
+            out.append((int(a), int(b)))
+        return out
+
+    def set_sample_count(self, vcf_location: str, n: int) -> None:
+        with self._lock:
+            self.conn.execute(
+                "UPDATE vcf_summaries SET sample_count = ? "
+                "WHERE vcf_location = ?",
+                (n, vcf_location),
+            )
+            self.conn.commit()
+
+    def complete_slice(
+        self,
+        vcf_location: str,
+        sl: tuple[int, int],
+        *,
+        variant_count: int,
+        call_count: int,
+    ) -> bool:
+        """Record one finished slice; True when it was the last pending
+        (the atomic ADD-counts + DELETE-slice barrier,
+        summariseSlice/main.cpp updateVcfSummary)."""
+        s = _slice_str(sl)
+        with self._lock:
+            row = self.conn.execute(
+                "SELECT to_update FROM vcf_summaries WHERE vcf_location = ?",
+                (vcf_location,),
+            ).fetchone()
+            if row is None or row[0] is None:
+                return False
+            pending = json.loads(row[0])
+            if s not in pending:  # already completed (idempotent redo)
+                return False
+            pending.remove(s)
+            self.conn.execute(
+                "UPDATE vcf_summaries SET to_update = ?, "
+                "variant_count = variant_count + ?, "
+                "call_count = call_count + ?, updated_at = ? "
+                "WHERE vcf_location = ?",
+                (
+                    json.dumps(pending),
+                    variant_count,
+                    call_count,
+                    time.time(),
+                    vcf_location,
+                ),
+            )
+            self.conn.commit()
+            return not pending
+
+    def vcf_summary(self, vcf_location: str) -> dict | None:
+        row = self.conn.execute(
+            "SELECT to_update, variant_count, call_count, sample_count "
+            "FROM vcf_summaries WHERE vcf_location = ?",
+            (vcf_location,),
+        ).fetchone()
+        if row is None:
+            return None
+        return {
+            "pending": json.loads(row[0]) if row[0] else [],
+            "variant_count": row[1],
+            "call_count": row[2],
+            "sample_count": row[3],
+        }
+
+    def vcf_is_summarised(self, vcf_location: str) -> bool:
+        s = self.vcf_summary(vcf_location)
+        return s is not None and not s["pending"] and s["sample_count"] is not None
+
+    # -- dataset aggregation state (reference Datasets control item) --------
+
+    def start_dataset(self, dataset_id: str, vcf_locations: list[str]) -> None:
+        with self._lock:
+            self.conn.execute(
+                "INSERT OR REPLACE INTO dataset_jobs VALUES "
+                "(?, ?, NULL, NULL, NULL, 'summarising', ?)",
+                (dataset_id, json.dumps(vcf_locations), time.time()),
+            )
+            self.conn.commit()
+
+    def finish_dataset(
+        self,
+        dataset_id: str,
+        *,
+        variant_count: int,
+        call_count: int,
+        sample_count: int,
+    ) -> None:
+        with self._lock:
+            self.conn.execute(
+                "UPDATE dataset_jobs SET to_update_files = '[]', "
+                "variant_count = ?, call_count = ?, sample_count = ?, "
+                "state = 'complete', updated_at = ? WHERE dataset_id = ?",
+                (
+                    variant_count,
+                    call_count,
+                    sample_count,
+                    time.time(),
+                    dataset_id,
+                ),
+            )
+            self.conn.commit()
+
+    def dataset_job(self, dataset_id: str) -> dict | None:
+        row = self.conn.execute(
+            "SELECT to_update_files, variant_count, call_count, "
+            "sample_count, state FROM dataset_jobs WHERE dataset_id = ?",
+            (dataset_id,),
+        ).fetchone()
+        if row is None:
+            return None
+        return {
+            "pending_files": json.loads(row[0]) if row[0] else [],
+            "variant_count": row[1],
+            "call_count": row[2],
+            "sample_count": row[3],
+            "state": row[4],
+        }
+
+    def close(self) -> None:
+        self.conn.close()

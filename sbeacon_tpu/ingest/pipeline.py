@@ -1,0 +1,272 @@
+"""The summarisation pipeline: sliced, parallel, resumable.
+
+Re-expresses the reference's four-stage SNS pipeline (reference:
+summariseDataset -> summariseVcf -> summariseSlice (C++) ->
+duplicateVariantSearch (C++); SURVEY.md §3.2) as one orchestrated run:
+
+- summariseVcf's planning (chunk boundaries + Newton-optimal slice size)
+  comes from ``planner.plan_slices``;
+- summariseSlice's per-slice scan (BGZF range read, record parse,
+  variant/call counting, index build) runs on a thread pool, each slice
+  persisting a partial shard — the unit of crash-resume;
+- the DynamoDB barrier set is the ``JobLedger``; a re-run processes only
+  slices still pending (reference toUpdate semantics);
+- duplicateVariantSearch's distinct-variant count is a set-union over the
+  merged shards' (contig, pos, ref, alt) keys — the same hash-set count
+  the C++ lambda computes per bp-range (duplicateVariantSearch.cpp:31-84),
+  without the fan-out because shards are local.
+"""
+
+from __future__ import annotations
+
+import logging
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+
+from ..config import BeaconConfig
+from ..genomics.bgzf import BgzfReader
+from ..genomics.tabix import ensure_index
+from ..genomics.vcf import parse_record, read_sample_names
+from ..index.columnar import (
+    VariantIndexShard,
+    build_index,
+    load_index,
+    merge_shards,
+    save_index,
+)
+from .ledger import JobLedger
+from .planner import plan_slices
+
+log = logging.getLogger(__name__)
+
+
+def read_slice_records(
+    vcf_path: str | Path, vstart: int, vend: int
+) -> list:
+    """Parse all records in a virtual-offset slice [vstart, vend)."""
+    reader = BgzfReader(vcf_path)
+    records = []
+    for _, line in reader.iter_lines(vstart, vend):
+        rec = parse_record(line)
+        if rec is not None:
+            records.append(rec)
+    return records
+
+
+class SummarisationPipeline:
+    def __init__(
+        self,
+        config: BeaconConfig | None = None,
+        *,
+        ledger: JobLedger | None = None,
+        engine=None,
+        store=None,
+    ):
+        self.config = config or BeaconConfig()
+        self.ledger = ledger or JobLedger(self.config.storage.ledger_db)
+        self.engine = engine
+        self.store = store
+
+    # -- paths --------------------------------------------------------------
+
+    def _vcf_key(self, vcf: str) -> str:
+        return str(vcf).replace("/", "%")
+
+    def shard_path(self, dataset_id: str, vcf: str) -> Path:
+        return (
+            self.config.storage.index_dir
+            / dataset_id
+            / f"{self._vcf_key(vcf)}.npz"
+        )
+
+    def _slice_dir(self, dataset_id: str, vcf: str) -> Path:
+        return (
+            self.config.storage.index_dir
+            / dataset_id
+            / f"{self._vcf_key(vcf)}.slices"
+        )
+
+    # -- per-VCF stage ------------------------------------------------------
+
+    def summarise_vcf(self, dataset_id: str, vcf: str) -> VariantIndexShard:
+        """Plan -> scan slices in parallel -> merge -> persist.
+
+        Idempotent and resumable: finished shard short-circuits; a partial
+        run re-processes only ledger-pending slices (persisted slice
+        shards are reused)."""
+        final = self.shard_path(dataset_id, vcf)
+        if final.exists() and self.ledger.vcf_is_summarised(str(vcf)):
+            return load_index(final)
+
+        index = ensure_index(vcf)
+        plan = plan_slices(index, self.config.ingest)
+        sample_names = read_sample_names(vcf)
+
+        resumed = False
+        if not self.ledger.mark_updating(str(vcf), plan.slices):
+            # already mid-summarisation: resume the pending remainder
+            resumed = True
+            log.info("resuming summarisation of %s", vcf)
+        pending = set(self.ledger.pending_slices(str(vcf)))
+        self.ledger.set_sample_count(str(vcf), len(sample_names))
+
+        slice_dir = self._slice_dir(dataset_id, vcf)
+        slice_dir.mkdir(parents=True, exist_ok=True)
+
+        def run_slice(sl: tuple[int, int]):
+            spath = slice_dir / f"{sl[0]}-{sl[1]}.npz"
+            if sl not in pending and spath.exists():
+                return  # finished in a previous run
+            records = read_slice_records(vcf, sl[0], sl[1])
+            shard = build_index(
+                records,
+                dataset_id=dataset_id,
+                vcf_location=str(vcf),
+                sample_names=sample_names,
+            )
+            save_index(shard, spath)
+            self.ledger.complete_slice(
+                str(vcf),
+                sl,
+                variant_count=shard.meta["variant_count"],
+                call_count=shard.meta["call_count"],
+            )
+
+        workers = max(1, self.config.ingest.workers)
+        if len(plan.slices) <= 1 or workers == 1:
+            for sl in plan.slices:
+                run_slice(sl)
+        else:
+            with ThreadPoolExecutor(workers) as pool:
+                list(pool.map(run_slice, plan.slices))
+
+        shards = []
+        for sl in plan.slices:
+            spath = slice_dir / f"{sl[0]}-{sl[1]}.npz"
+            shards.append(load_index(spath))
+        merged = (
+            merge_shards(shards)
+            if shards
+            else build_index(
+                [],
+                dataset_id=dataset_id,
+                vcf_location=str(vcf),
+                sample_names=sample_names,
+            )
+        )
+        # merged meta keeps the identity of this (dataset, vcf) pair
+        merged.meta["dataset_id"] = dataset_id
+        merged.meta["vcf_location"] = str(vcf)
+        save_index(merged, final)
+        for p in slice_dir.glob("*"):
+            p.unlink()
+        slice_dir.rmdir()
+        if resumed:
+            log.info("resumed summarisation of %s complete", vcf)
+        return merged
+
+    # -- dataset stage ------------------------------------------------------
+
+    def summarise_dataset(self, dataset_id: str, vcf_locations: list[str]):
+        """Summarise every VCF, compute dataset-level stats (distinct
+        variants across VCFs = the duplicateVariantSearch role), pin
+        shards to the engine; returns the stats dict."""
+        self.ledger.start_dataset(dataset_id, vcf_locations)
+        shards = []
+        for vcf in vcf_locations:
+            shard = self.summarise_vcf(dataset_id, vcf)
+            shards.append(shard)
+            if self.engine is not None:
+                self.engine.add_index(shard)
+
+        distinct = distinct_variant_count(shards)
+        call_count = sum(s.meta["call_count"] for s in shards)
+        # sample count: once per VCF group; a plain submission has one
+        # group per VCF (reference summariseDataset:87-124 counts samples
+        # once per vcfGroup)
+        sample_count = sum(s.meta["sample_count"] for s in shards)
+        self.ledger.finish_dataset(
+            dataset_id,
+            variant_count=distinct,
+            call_count=call_count,
+            sample_count=sample_count,
+        )
+        return {
+            "datasetId": dataset_id,
+            "variantCount": distinct,
+            "callCount": call_count,
+            "sampleCount": sample_count,
+        }
+
+
+def distinct_variant_count(shards: list[VariantIndexShard]) -> int:
+    """Distinct (contig, pos, ref, alt) across shards — the reference's
+    cross-VCF duplicate-variant tally (duplicateVariantSearch.cpp
+    unordered_set<pos + ref_alt> insert loop), computed over the columnar
+    index instead of re-downloading binary range files.
+
+    Vectorised: rows are grouped by the fixed-width key
+    (chrom_code, pos, ref_hash, alt_hash, ref_len, alt_len) with one
+    np.unique; only rows sharing a key (true cross-VCF duplicates, or the
+    astronomically rare double-FNV collision) fall back to exact byte
+    comparison, so the count is exact without a per-row Python loop."""
+    import numpy as np
+
+    if not shards:
+        return 0
+    key_parts = []
+    for s in shards:
+        codes = (
+            np.searchsorted(
+                s.chrom_offsets, np.arange(s.n_rows), side="right"
+            )
+            - 1
+        ).astype(np.int64)
+        key_parts.append(
+            np.stack(
+                [
+                    codes,
+                    s.cols["pos"].astype(np.int64),
+                    s.cols["ref_hash"].astype(np.int64),
+                    s.cols["alt_hash"].astype(np.int64),
+                    s.cols["ref_len"].astype(np.int64),
+                    s.cols["alt_len"].astype(np.int64),
+                ],
+                axis=1,
+            )
+        )
+    keys = np.concatenate(key_parts)
+    n = len(keys)
+    if n == 0:
+        return 0
+    # contiguous void view -> row-wise unique without axis= overhead
+    voids = np.ascontiguousarray(keys).view(
+        np.dtype((np.void, keys.dtype.itemsize * keys.shape[1]))
+    ).ravel()
+    uniq, inverse, counts = np.unique(
+        voids, return_inverse=True, return_counts=True
+    )
+    total = int((counts == 1).sum())
+    if len(uniq) == n:
+        return total
+    # exact pass over rows whose key repeats
+    shard_of = np.concatenate(
+        [np.full(s.n_rows, k, dtype=np.int32) for k, s in enumerate(shards)]
+    )
+    row_of = np.concatenate(
+        [np.arange(s.n_rows, dtype=np.int64) for s in shards]
+    )
+    dup_groups = np.flatnonzero(counts > 1)
+    dup_mask = np.isin(inverse, dup_groups)
+    per_group: dict[int, set] = {}
+    for gi, sk, rk in zip(
+        inverse[dup_mask], shard_of[dup_mask], row_of[dup_mask]
+    ):
+        s = shards[sk]
+        allele = (
+            bytes(s.ref_blob[s.ref_off[rk] : s.ref_off[rk + 1]]),
+            bytes(s.alt_blob[s.alt_off[rk] : s.alt_off[rk + 1]]),
+        )
+        per_group.setdefault(int(gi), set()).add(allele)
+    total += sum(len(v) for v in per_group.values())
+    return total

@@ -15,9 +15,10 @@ from pathlib import Path
 
 from ..config import BeaconConfig
 from ..genomics.tabix import ensure_index, list_chromosomes
-from ..genomics.vcf import iter_vcf_records, read_sample_names
-from ..index.columnar import build_index, load_index, save_index
+from ..index.columnar import load_index
 from ..utils.chrom import get_matching_chromosome  # noqa: F401 (API parity)
+from .ledger import JobLedger
+from .pipeline import SummarisationPipeline
 
 
 class VcfLocationError(ValueError):
@@ -25,10 +26,25 @@ class VcfLocationError(ValueError):
 
 
 class IngestService:
-    def __init__(self, config: BeaconConfig | None = None, *, engine=None, store=None):
+    def __init__(
+        self,
+        config: BeaconConfig | None = None,
+        *,
+        engine=None,
+        store=None,
+    ):
+        # an explicit config means a real storage root: persist the ledger
+        # there; the configless form stays fully in-memory (tests, ad hoc)
+        persistent = config is not None
         self.config = config or BeaconConfig()
         self.engine = engine
         self.store = store
+        self.ledger = JobLedger(
+            self.config.storage.ledger_db if persistent else ":memory:"
+        )
+        self.pipeline = SummarisationPipeline(
+            self.config, ledger=self.ledger, engine=engine, store=store
+        )
 
     # -- submission-time checks --------------------------------------------
 
@@ -60,44 +76,24 @@ class IngestService:
 
     # -- summarisation ------------------------------------------------------
 
-    def _shard_path(self, dataset_id: str, vcf: str) -> Path:
-        safe = str(vcf).replace("/", "%")
-        return self.config.storage.index_dir / dataset_id / f"{safe}.npz"
-
-    def summarise_vcf(self, dataset_id: str, vcf: str):
-        """Build (or reload) the columnar index shard for one VCF."""
-        path = self._shard_path(dataset_id, vcf)
-        if path.exists():
-            return load_index(path)
-        sample_names = read_sample_names(vcf)
-        records = list(iter_vcf_records(vcf))
-        shard = build_index(
-            records,
-            dataset_id=dataset_id,
-            vcf_location=str(vcf),
-            sample_names=sample_names,
-        )
-        save_index(shard, path)
-        return shard
-
     def schedule_summarisation(self, dataset_id: str) -> list[str]:
-        """Summarise every VCF of the dataset and pin shards to the engine.
-
-        Synchronous equivalent of the reference's SNS pipeline kick; returns
-        progress messages for the submit response.
-        """
+        """Run the sliced summarisation pipeline for the dataset's VCFs and
+        pin shards to the engine (the reference's SNS pipeline kick, run
+        in-process); returns progress messages for the submit response."""
         if self.store is None:
             return []
         doc = self.store.get_by_id("datasets", dataset_id)
         if doc is None:
             return []
-        messages = []
-        for vcf in doc.get("_vcfLocations", []):
-            shard = self.summarise_vcf(dataset_id, vcf)
-            if self.engine is not None:
-                self.engine.add_index(shard)
-            messages.append(f"Summarised {vcf}")
-        return messages
+        vcfs = doc.get("_vcfLocations", [])
+        if not vcfs:
+            return []
+        stats = self.pipeline.summarise_dataset(dataset_id, vcfs)
+        return [
+            f"Summarised {len(vcfs)} VCF(s): "
+            f"{stats['variantCount']} distinct variants, "
+            f"{stats['callCount']} calls, {stats['sampleCount']} samples"
+        ]
 
     def load_all(self) -> int:
         """Re-pin every persisted shard (startup / crash-resume); returns

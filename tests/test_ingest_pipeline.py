@@ -1,0 +1,222 @@
+"""Ingestion pipeline: slice planning math, resumable ledger, sliced
+summarisation parity, distinct-variant counting."""
+
+import random
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu.config import BeaconConfig, IngestConfig, StorageConfig
+from sbeacon_tpu.genomics.tabix import ensure_index
+from sbeacon_tpu.genomics.vcf import write_vcf
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.ingest.ledger import JobLedger
+from sbeacon_tpu.ingest.pipeline import (
+    SummarisationPipeline,
+    distinct_variant_count,
+)
+from sbeacon_tpu.ingest.planner import (
+    chunk_boundaries,
+    find_best_split,
+    pack_ranges,
+    partition_chunks,
+    plan_slices,
+)
+from sbeacon_tpu.testing import random_records
+
+COST = IngestConfig(
+    min_task_time=0.1,
+    scan_rate=75_000_000,
+    dispatch_cost=0.02,
+    max_concurrency=1000,
+)
+
+
+def _cost_fn(total, s, c=COST):
+    """total_time * cost objective the Newton step optimises: time and cost
+    of ceil-free n=total/s tasks of size s."""
+    n = total / s
+    task = c.min_task_time + s / c.scan_rate
+    return (n * c.dispatch_cost + task) * (n * task)
+
+
+def test_find_best_split_minimises_objective():
+    for total in (10_000_000, 500_000_000, 5_000_000_000):
+        best = find_best_split(total, total / 1e6, COST)
+        f0 = _cost_fn(total, best)
+        # no grid point does noticeably better than the Newton optimum
+        grid = np.geomspace(total / 10_000, total, 400)
+        assert all(f0 <= _cost_fn(total, float(s)) * 1.001 for s in grid), (
+            total,
+            best,
+        )
+
+
+def test_partition_chunks_properties():
+    boundaries = {
+        "1": [(10 << 16), (50 << 16), (120 << 16), (300 << 16)],
+        "2": [(400 << 16), (450 << 16) | 7, (900 << 16)],
+    }
+    slices = partition_chunks(boundaries, 100.0)
+    # every slice endpoint is a chunk boundary; slices tile each contig
+    all_bounds = {v for b in boundaries.values() for v in b}
+    for a, b in slices:
+        assert a in all_bounds and b in all_bounds and a < b
+    for name, b in boundaries.items():
+        contig_slices = [s for s in slices if s[0] in set(b)]
+        assert contig_slices[0][0] == b[0]
+        assert contig_slices[-1][1] == b[-1]
+        for (a1, b1), (a2, b2) in zip(contig_slices, contig_slices[1:]):
+            assert b1 == a2
+
+
+def test_pack_ranges():
+    items = [(0, 10, 400), (10, 20, 400), (20, 30, 400), (30, 40, 100)]
+    ranges = pack_ranges(items, 800)
+    assert ranges == [(0, 20), (20, 40)]
+    assert pack_ranges([], 100) == []
+    # one oversize item still lands in its own range
+    assert pack_ranges([(0, 5, 10_000)], 800) == [(0, 5)]
+
+
+@pytest.fixture()
+def corpus(tmp_path):
+    rng = random.Random(13)
+    recs = []
+    for chrom in ("1", "2"):
+        recs.extend(
+            random_records(
+                rng, chrom=chrom, n=400, n_samples=3, p_no_acan=0.3
+            )
+        )
+    vcf = tmp_path / "c.vcf.gz"
+    write_vcf(vcf, recs, sample_names=["X", "Y", "Z"])
+    ensure_index(vcf)
+    return tmp_path, vcf, recs
+
+
+def _pipeline(tmp_path, workers=4):
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "data"),
+        ingest=IngestConfig(
+            # tiny slice budget to force multiple slices on a small file
+            min_task_time=1e-6,
+            scan_rate=1e6,
+            dispatch_cost=1e-7,
+            max_concurrency=1000,
+            workers=workers,
+        ),
+    )
+    cfg.storage.ensure()
+    return SummarisationPipeline(cfg, ledger=JobLedger())
+
+
+def test_sliced_summarisation_parity(corpus):
+    tmp_path, vcf, recs = corpus
+    pipe = _pipeline(tmp_path)
+    plan = plan_slices(ensure_index(vcf), pipe.config.ingest)
+    assert len(plan.slices) >= 2, "fixture must exercise multi-slice path"
+
+    shard = pipe.summarise_vcf("ds", str(vcf))
+    want = build_index(
+        recs, dataset_id="ds", vcf_location=str(vcf), sample_names=["X", "Y", "Z"]
+    )
+    assert shard.n_rows == want.n_rows
+    np.testing.assert_array_equal(shard.cols["pos"], want.cols["pos"])
+    np.testing.assert_array_equal(shard.cols["ac"], want.cols["ac"])
+    np.testing.assert_array_equal(shard.cols["an"], want.cols["an"])
+    np.testing.assert_array_equal(shard.gt_bits, want.gt_bits)
+    assert shard.meta["call_count"] == want.meta["call_count"]
+
+    summary = pipe.ledger.vcf_summary(str(vcf))
+    assert summary["pending"] == []
+    assert summary["variant_count"] == want.n_rows
+    assert summary["call_count"] == want.meta["call_count"]
+    assert summary["sample_count"] == 3
+
+
+def test_dataset_stage_distinct_count(corpus, tmp_path):
+    tmp_path_, vcf, recs = corpus
+    # second VCF: half overlapping records, so distinct < sum
+    overlap = recs[: len(recs) // 2]
+    rng = random.Random(77)
+    extra = random_records(rng, chrom="3", n=100, n_samples=3)
+    vcf2 = tmp_path_ / "c2.vcf.gz"
+    write_vcf(vcf2, overlap + extra, sample_names=["X", "Y", "Z"])
+    ensure_index(vcf2)
+
+    pipe = _pipeline(tmp_path_)
+    stats = pipe.summarise_dataset("ds", [str(vcf), str(vcf2)])
+
+    brute = {
+        (r.chrom, r.pos, r.ref, alt)
+        for r in recs + overlap + extra
+        for alt in r.alts
+    }
+    assert stats["variantCount"] == len(brute)
+    assert stats["sampleCount"] == 6  # 3 per VCF, one group each
+    job = pipe.ledger.dataset_job("ds")
+    assert job["state"] == "complete"
+    assert job["variant_count"] == len(brute)
+
+
+def test_resume_after_crash(corpus, monkeypatch):
+    tmp_path, vcf, recs = corpus
+    pipe = _pipeline(tmp_path, workers=1)
+
+    import sbeacon_tpu.ingest.pipeline as pl
+
+    real = pl.read_slice_records
+    plan = plan_slices(ensure_index(vcf), pipe.config.ingest)
+    poison = plan.slices[len(plan.slices) // 2]
+    calls = {"n": 0}
+
+    def flaky(path, a, b):
+        if (a, b) == poison and calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("simulated crash")
+        return real(path, a, b)
+
+    monkeypatch.setattr(pl, "read_slice_records", flaky)
+    with pytest.raises(RuntimeError):
+        pipe.summarise_vcf("ds", str(vcf))
+
+    # partial state: some slices completed, poison still pending
+    pending = pipe.ledger.pending_slices(str(vcf))
+    assert poison in pending
+    assert len(pending) < len(plan.slices)
+
+    # second run resumes and completes with exact counts
+    shard = pipe.summarise_vcf("ds", str(vcf))
+    want = build_index(
+        recs, dataset_id="ds", vcf_location=str(vcf), sample_names=["X", "Y", "Z"]
+    )
+    assert shard.n_rows == want.n_rows
+    summary = pipe.ledger.vcf_summary(str(vcf))
+    assert summary["pending"] == []
+    assert summary["variant_count"] == want.n_rows
+    assert summary["call_count"] == want.meta["call_count"]
+
+    # third run short-circuits on the persisted shard
+    again = pipe.summarise_vcf("ds", str(vcf))
+    assert again.n_rows == shard.n_rows
+
+
+def test_distinct_variant_count_unit():
+    rng = random.Random(3)
+    recs = random_records(rng, chrom="5", n=50, n_samples=0)
+    s1 = build_index(recs, dataset_id="a")
+    s2 = build_index(recs[:25], dataset_id="b")
+    brute = {
+        (r.chrom, r.pos, r.ref, a) for r in recs for a in r.alts
+    }
+    assert distinct_variant_count([s1, s2]) == len(brute)
+
+
+def test_chunk_boundaries_excludes_pseudobins(corpus):
+    _, vcf, _ = corpus
+    idx = ensure_index(vcf)
+    b = chunk_boundaries(idx)
+    assert set(b) == {"1", "2"}
+    for offs in b.values():
+        assert offs == sorted(offs) and len(offs) == len(set(offs))
