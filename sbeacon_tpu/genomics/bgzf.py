@@ -173,11 +173,30 @@ class BgzfReader:
     """
 
     def __init__(self, path: str | Path):
-        with open(path, "rb") as fh:
-            self._data = fh.read()
-        self._block_cache_off = -1
+        self._path = str(path)
+        self._data_loaded: bytes | None = None  # lazy: native paths never
+        self._block_cache_off = -1              # touch the python copy
         self._block_cache: bytes = b""
         self._block_cache_size = 0
+
+    @property
+    def _data(self) -> bytes:
+        if self._data_loaded is None:
+            with open(self._path, "rb") as fh:
+                self._data_loaded = fh.read()
+        return self._data_loaded
+
+    def _native(self):
+        """The C++ codec when built (parallel block inflate); None keeps
+        the pure-Python path (also on single-core hosts, where the pool
+        cannot beat python's one-shot zlib — see native.prefer_native_io).
+        """
+        try:
+            from .. import native
+
+            return native if native.prefer_native_io() else None
+        except Exception:
+            return None
 
     def _load_block(self, coffset: int) -> bytes:
         if coffset != self._block_cache_off:
@@ -188,6 +207,12 @@ class BgzfReader:
         return self._block_cache
 
     def read_all(self) -> bytes:
+        nat = self._native()
+        if nat is not None:
+            try:
+                return nat.inflate_range(self._path)
+            except Exception:
+                pass
         out = io.BytesIO()
         pos = 0
         while pos < len(self._data):
@@ -198,6 +223,14 @@ class BgzfReader:
 
     def read_range(self, voffset_start: int, voffset_end: int) -> bytes:
         """Uncompressed bytes in [voffset_start, voffset_end)."""
+        nat = self._native()
+        if nat is not None:
+            try:
+                return nat.inflate_range(
+                    self._path, voffset_start, voffset_end
+                )
+            except Exception:
+                pass
         out = io.BytesIO()
         coff, uoff = split_virtual_offset(voffset_start)
         end_coff, end_uoff = split_virtual_offset(voffset_end)

@@ -403,7 +403,10 @@ def _split_gt(gt: str) -> list[str]:
 
 
 def save_index(shard: VariantIndexShard, path: str | Path) -> None:
-    """Persist a shard as one compressed npz + json meta sidecar."""
+    """Persist a shard as one compressed npz + json meta sidecar.
+
+    Writes are atomic (tmp + rename) so a crash mid-save can never leave a
+    truncated shard that bricks the resume path."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     arrays = {f"col_{k}": v for k, v in shard.cols.items()}
@@ -424,8 +427,14 @@ def save_index(shard: VariantIndexShard, path: str | Path) -> None:
         arr = getattr(shard, plane)
         if arr is not None:
             arrays[plane] = arr
-    np.savez_compressed(path, **arrays)
-    Path(str(path) + ".meta.json").write_text(json.dumps(shard.meta))
+    import os
+
+    tmp = path.with_name(path.name + ".tmp.npz")
+    np.savez_compressed(tmp, **arrays)
+    os.replace(tmp, path if path.suffix == ".npz" else str(path) + ".npz")
+    meta_tmp = Path(str(path) + ".meta.json.tmp")
+    meta_tmp.write_text(json.dumps(shard.meta))
+    os.replace(meta_tmp, str(path) + ".meta.json")
 
 
 def load_index(path: str | Path) -> VariantIndexShard:

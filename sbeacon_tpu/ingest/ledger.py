@@ -32,6 +32,34 @@ def _slice_str(s: tuple[int, int]) -> str:
     return f"{s[0]}-{s[1]}"
 
 
+class _ImmediateTxn:
+    """``with`` helper: threading lock + BEGIN IMMEDIATE, commit on clean
+    exit, rollback on exception."""
+
+    def __init__(self, conn: sqlite3.Connection, lock: threading.Lock):
+        self.conn = conn
+        self.lock = lock
+
+    def __enter__(self):
+        self.lock.acquire()
+        try:
+            self.conn.execute("BEGIN IMMEDIATE")
+        except BaseException:
+            self.lock.release()
+            raise
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        try:
+            if exc_type is None:
+                self.conn.execute("COMMIT")
+            else:
+                self.conn.execute("ROLLBACK")
+        finally:
+            self.lock.release()
+        return False
+
+
 class JobLedger:
     def __init__(self, path: str | Path = ":memory:"):
         if path != ":memory:":
@@ -43,6 +71,7 @@ class JobLedger:
             CREATE TABLE IF NOT EXISTS vcf_summaries (
                 vcf_location TEXT PRIMARY KEY,
                 to_update TEXT,          -- JSON list of pending slice strings
+                all_slices TEXT,         -- JSON list of the claimed plan
                 variant_count INTEGER,
                 call_count INTEGER,
                 sample_count INTEGER,
@@ -63,42 +92,49 @@ class JobLedger:
 
     # -- VCF summarisation state (reference VcfSummaries table) -------------
 
+    def _txn(self):
+        """BEGIN IMMEDIATE context: write lock up front so read-modify-
+        write sequences are atomic across *processes* sharing the ledger
+        file, not just threads (the DynamoDB conditional-write equivalence
+        the module docstring promises)."""
+        return _ImmediateTxn(self.conn, self._lock)
+
     def mark_updating(
         self, vcf_location: str, slices: list[tuple[int, int]]
     ) -> bool:
         """Claim a VCF for summarisation; False when already in progress
         (the reference's attribute_not_exists(toUpdate) condition)."""
         pending = json.dumps([_slice_str(s) for s in slices])
-        with self._lock:
-            # BEGIN IMMEDIATE takes the write lock up front so the
-            # check-then-insert is atomic across *processes* sharing the
-            # ledger file, not just threads (the DynamoDB conditional-write
-            # equivalence the module docstring promises)
-            self.conn.execute("BEGIN IMMEDIATE")
-            try:
-                row = self.conn.execute(
-                    "SELECT to_update FROM vcf_summaries "
-                    "WHERE vcf_location = ?",
-                    (vcf_location,),
-                ).fetchone()
-                if (
-                    row is not None
-                    and row[0] is not None
-                    and json.loads(row[0])
-                ):
-                    self.conn.execute("ROLLBACK")
-                    return False
-                # counts cleared on (re)start, like the REMOVE of COUNTS
-                self.conn.execute(
-                    "INSERT OR REPLACE INTO vcf_summaries VALUES "
-                    "(?, ?, 0, 0, NULL, ?)",
-                    (vcf_location, pending, time.time()),
-                )
-                self.conn.execute("COMMIT")
-            except BaseException:
-                self.conn.execute("ROLLBACK")
-                raise
+        with self._txn():
+            row = self.conn.execute(
+                "SELECT to_update FROM vcf_summaries "
+                "WHERE vcf_location = ?",
+                (vcf_location,),
+            ).fetchone()
+            if row is not None and row[0] is not None and json.loads(row[0]):
+                return False
+            # counts cleared on (re)start, like the REMOVE of COUNTS
+            self.conn.execute(
+                "INSERT OR REPLACE INTO vcf_summaries VALUES "
+                "(?, ?, ?, 0, 0, NULL, ?)",
+                (vcf_location, pending, pending, time.time()),
+            )
         return True
+
+    def claimed_slices(self, vcf_location: str) -> list[tuple[int, int]]:
+        """The slice plan stored at claim time — resume must use THIS,
+        not a freshly computed plan (config/index drift would otherwise
+        strand the pending set forever)."""
+        row = self.conn.execute(
+            "SELECT all_slices FROM vcf_summaries WHERE vcf_location = ?",
+            (vcf_location,),
+        ).fetchone()
+        if row is None or row[0] is None:
+            return []
+        return [
+            (int(s.split("-")[0]), int(s.split("-")[1]))
+            for s in json.loads(row[0])
+        ]
 
     def pending_slices(self, vcf_location: str) -> list[tuple[int, int]]:
         row = self.conn.execute(
@@ -114,13 +150,12 @@ class JobLedger:
         return out
 
     def set_sample_count(self, vcf_location: str, n: int) -> None:
-        with self._lock:
+        with self._txn():
             self.conn.execute(
                 "UPDATE vcf_summaries SET sample_count = ? "
                 "WHERE vcf_location = ?",
                 (n, vcf_location),
             )
-            self.conn.commit()
 
     def complete_slice(
         self,
@@ -134,7 +169,7 @@ class JobLedger:
         (the atomic ADD-counts + DELETE-slice barrier,
         summariseSlice/main.cpp updateVcfSummary)."""
         s = _slice_str(sl)
-        with self._lock:
+        with self._txn():
             row = self.conn.execute(
                 "SELECT to_update FROM vcf_summaries WHERE vcf_location = ?",
                 (vcf_location,),
@@ -158,7 +193,6 @@ class JobLedger:
                     vcf_location,
                 ),
             )
-            self.conn.commit()
             return not pending
 
     def vcf_summary(self, vcf_location: str) -> dict | None:
@@ -183,13 +217,12 @@ class JobLedger:
     # -- dataset aggregation state (reference Datasets control item) --------
 
     def start_dataset(self, dataset_id: str, vcf_locations: list[str]) -> None:
-        with self._lock:
+        with self._txn():
             self.conn.execute(
                 "INSERT OR REPLACE INTO dataset_jobs VALUES "
                 "(?, ?, NULL, NULL, NULL, 'summarising', ?)",
                 (dataset_id, json.dumps(vcf_locations), time.time()),
             )
-            self.conn.commit()
 
     def finish_dataset(
         self,
@@ -199,7 +232,7 @@ class JobLedger:
         call_count: int,
         sample_count: int,
     ) -> None:
-        with self._lock:
+        with self._txn():
             self.conn.execute(
                 "UPDATE dataset_jobs SET to_update_files = '[]', "
                 "variant_count = ?, call_count = ?, sample_count = ?, "
@@ -212,7 +245,6 @@ class JobLedger:
                     dataset_id,
                 ),
             )
-            self.conn.commit()
 
     def dataset_job(self, dataset_id: str) -> dict | None:
         row = self.conn.execute(

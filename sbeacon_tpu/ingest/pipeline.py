@@ -20,6 +20,7 @@ duplicateVariantSearch (C++); SURVEY.md §3.2) as one orchestrated run:
 from __future__ import annotations
 
 import logging
+import threading
 from concurrent.futures import ThreadPoolExecutor
 from pathlib import Path
 
@@ -43,7 +44,27 @@ log = logging.getLogger(__name__)
 def read_slice_records(
     vcf_path: str | Path, vstart: int, vend: int
 ) -> list:
-    """Parse all records in a virtual-offset slice [vstart, vend)."""
+    """Parse all records in a virtual-offset slice [vstart, vend).
+
+    Decompression goes through the native parallel BGZF codec when built
+    (native.inflate_range), but a slice's text must include the record that
+    *starts* before ``vend``'s block boundary finishes, so the tail is
+    completed from the python reader's line iterator semantics: slices are
+    planned on chunk boundaries (record starts), which makes the naive
+    range exact here."""
+    try:
+        from .. import native
+
+        if native.prefer_native_io():
+            text = native.inflate_range(str(vcf_path), vstart, vend)
+            records = []
+            for line in text.split(b"\n"):
+                rec = parse_record(line)
+                if rec is not None:
+                    records.append(rec)
+            return records
+    except Exception:  # fall back to the pure-python reader
+        pass
     reader = BgzfReader(vcf_path)
     records = []
     for _, line in reader.iter_lines(vstart, vend):
@@ -66,6 +87,14 @@ class SummarisationPipeline:
         self.ledger = ledger or JobLedger(self.config.storage.ledger_db)
         self.engine = engine
         self.store = store
+        # in-process serialisation per VCF: concurrent submissions of the
+        # same dataset must not race-write the same shard files
+        self._vcf_locks: dict[str, threading.Lock] = {}
+        self._locks_guard = threading.Lock()
+
+    def _vcf_lock(self, vcf: str) -> threading.Lock:
+        with self._locks_guard:
+            return self._vcf_locks.setdefault(str(vcf), threading.Lock())
 
     # -- paths --------------------------------------------------------------
 
@@ -93,19 +122,30 @@ class SummarisationPipeline:
 
         Idempotent and resumable: finished shard short-circuits; a partial
         run re-processes only ledger-pending slices (persisted slice
-        shards are reused)."""
+        shards are reused). Concurrent in-process calls for the same VCF
+        serialise on a lock — the second caller then takes the finished-
+        shard short-circuit."""
+        with self._vcf_lock(vcf):
+            return self._summarise_vcf_locked(dataset_id, vcf)
+
+    def _summarise_vcf_locked(
+        self, dataset_id: str, vcf: str
+    ) -> VariantIndexShard:
         final = self.shard_path(dataset_id, vcf)
         if final.exists() and self.ledger.vcf_is_summarised(str(vcf)):
             return load_index(final)
 
-        index = ensure_index(vcf)
-        plan = plan_slices(index, self.config.ingest)
         sample_names = read_sample_names(vcf)
 
         resumed = False
+        plan = plan_slices(ensure_index(vcf), self.config.ingest)
         if not self.ledger.mark_updating(str(vcf), plan.slices):
-            # already mid-summarisation: resume the pending remainder
+            # a previous (crashed) run holds the claim: resume with the
+            # slice plan *stored at claim time* — a freshly computed plan
+            # may drift (config change, regenerated index) and would then
+            # never match the pending slice strings
             resumed = True
+            plan.slices = self.ledger.claimed_slices(str(vcf))
             log.info("resuming summarisation of %s", vcf)
         pending = set(self.ledger.pending_slices(str(vcf)))
         self.ledger.set_sample_count(str(vcf), len(sample_names))
@@ -179,7 +219,9 @@ class SummarisationPipeline:
             if self.engine is not None:
                 self.engine.add_index(shard)
 
-        distinct = distinct_variant_count(shards)
+        distinct = distinct_variant_count(
+            shards, max_range_bytes=self.config.ingest.max_range_bytes
+        )
         call_count = sum(s.meta["call_count"] for s in shards)
         # sample count: once per VCF group; a plain submission has one
         # group per VCF (reference summariseDataset:87-124 counts samples
@@ -199,7 +241,9 @@ class SummarisationPipeline:
         }
 
 
-def distinct_variant_count(shards: list[VariantIndexShard]) -> int:
+def distinct_variant_count(
+    shards: list[VariantIndexShard], *, max_range_bytes: int | None = None
+) -> int:
     """Distinct (contig, pos, ref, alt) across shards — the reference's
     cross-VCF duplicate-variant tally (duplicateVariantSearch.cpp
     unordered_set<pos + ref_alt> insert loop), computed over the columnar
@@ -209,7 +253,14 @@ def distinct_variant_count(shards: list[VariantIndexShard]) -> int:
     (chrom_code, pos, ref_hash, alt_hash, ref_len, alt_len) with one
     np.unique; only rows sharing a key (true cross-VCF duplicates, or the
     astronomically rare double-FNV collision) fall back to exact byte
-    comparison, so the count is exact without a per-row Python loop."""
+    comparison, so the count is exact without a per-row Python loop.
+
+    ``max_range_bytes`` bounds peak memory the way the reference's
+    ABS_MAX_DATA_SPLIT bounds its dup-search fan-out ranges
+    (initDuplicateVariantSearch.py greedy packing): when the key matrix
+    would exceed it, rows are partitioned into disjoint (contig, pos)
+    chunks and counted chunk by chunk — distinctness over disjoint
+    position ranges sums exactly."""
     import numpy as np
 
     if not shards:
@@ -239,7 +290,50 @@ def distinct_variant_count(shards: list[VariantIndexShard]) -> int:
     n = len(keys)
     if n == 0:
         return 0
-    # contiguous void view -> row-wise unique without axis= overhead
+
+    shard_of = np.concatenate(
+        [np.full(s.n_rows, k, dtype=np.int32) for k, s in enumerate(shards)]
+    )
+    row_of = np.concatenate(
+        [np.arange(s.n_rows, dtype=np.int64) for s in shards]
+    )
+
+    row_bytes = keys.dtype.itemsize * keys.shape[1]
+    if max_range_bytes is not None and n * row_bytes > max_range_bytes:
+        # partition into disjoint (code, pos) chunks and sum — bounded
+        # peak memory, exact total
+        order = np.lexsort((keys[:, 1], keys[:, 0]))
+        keys = keys[order]
+        shard_of = shard_of[order]
+        row_of = row_of[order]
+        rows_per_range = max(1, max_range_bytes // row_bytes)
+        total = 0
+        start = 0
+        while start < n:
+            end = min(n, start + rows_per_range)
+            # extend so equal (code, pos) rows stay in one chunk
+            while end < n and (
+                keys[end, 0] == keys[end - 1, 0]
+                and keys[end, 1] == keys[end - 1, 1]
+            ):
+                end += 1
+            total += _distinct_exact(
+                keys[start:end],
+                shard_of[start:end],
+                row_of[start:end],
+                shards,
+            )
+            start = end
+        return total
+    return _distinct_exact(keys, shard_of, row_of, shards)
+
+
+def _distinct_exact(keys, shard_of, row_of, shards) -> int:
+    """Exact distinct count of one key chunk: hash-grouped np.unique, byte
+    verification only for rows whose key repeats."""
+    import numpy as np
+
+    n = len(keys)
     voids = np.ascontiguousarray(keys).view(
         np.dtype((np.void, keys.dtype.itemsize * keys.shape[1]))
     ).ravel()
@@ -249,13 +343,6 @@ def distinct_variant_count(shards: list[VariantIndexShard]) -> int:
     total = int((counts == 1).sum())
     if len(uniq) == n:
         return total
-    # exact pass over rows whose key repeats
-    shard_of = np.concatenate(
-        [np.full(s.n_rows, k, dtype=np.int32) for k, s in enumerate(shards)]
-    )
-    row_of = np.concatenate(
-        [np.arange(s.n_rows, dtype=np.int64) for s in shards]
-    )
     dup_groups = np.flatnonzero(counts > 1)
     dup_mask = np.isin(inverse, dup_groups)
     per_group: dict[int, set] = {}

@@ -144,11 +144,14 @@ def plan_slices(index: TabixIndex, cost: IngestConfig | None = None) -> SlicePla
 def pack_ranges(
     items: list[tuple[int, int, int]], max_bytes: int
 ) -> list[tuple[int, int]]:
-    """Greedy base-pair range packing for the distinct-variant reduction:
-    items are (start_bp, end_bp, size_bytes) sorted-by-start index files;
-    returns contiguous (start_bp, end_bp) bins whose member files total
-    <= max_bytes (reference initDuplicateVariantSearch.calcRangeSplits /
-    addRange greedy packing under ABS_MAX_DATA_SPLIT)."""
+    """Greedy base-pair range packing: items are (start_bp, end_bp,
+    size_bytes) sorted-by-start work units; returns contiguous
+    (start_bp, end_bp) bins whose members total <= max_bytes (reference
+    initDuplicateVariantSearch.calcRangeSplits / addRange greedy packing
+    under ABS_MAX_DATA_SPLIT). This is the shard planner for the
+    mesh-distributed dedupe reduction (SURVEY.md §2.5 range-packed
+    reduce), where each bin becomes one device-shard task; the local
+    distinct count bounds memory with plain row chunking instead."""
     if not items:
         return []
     items = sorted(items)

@@ -103,6 +103,8 @@ class IngestService:
         if not idx_dir.exists() or self.engine is None:
             return 0
         for path in sorted(idx_dir.glob("*/*.npz")):
+            if path.name.endswith(".tmp.npz"):  # interrupted atomic save
+                continue
             self.engine.add_index(load_index(path))
             n += 1
         return n

@@ -1,0 +1,204 @@
+"""Native (C++) hot-path library: BGZF codec + VCF slice scanner.
+
+One coherent C++17 library replacing the reference's scattered native
+components (SURVEY.md §2.1 ledger: VcfChunkReader, Downloader, shared/gzip,
+thread_pool, fast_atoi, the summariseSlice scan loop). Built on demand with
+g++ (no external build system), loaded via ctypes — per the environment
+contract there is no pybind11; the ABI is a flat C surface over malloc'd
+buffers.
+
+Every entry point has a pure-Python fallback in ``genomics/``; callers use
+``available()`` or just call the wrappers, which raise ``NativeUnavailable``
+when the toolchain/library is missing so the Python path can take over.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+import threading
+from pathlib import Path
+
+log = logging.getLogger(__name__)
+
+_SRC = Path(__file__).parent / "src"
+_LIB_PATH = Path(__file__).parent / "_sbnative.so"
+_SOURCES = ["bgzf.cpp", "scan.cpp"]
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _newest_source_mtime() -> float:
+    return max((_SRC / s).stat().st_mtime for s in _SOURCES)
+
+
+def build(force: bool = False) -> Path:
+    """Compile the shared library (cached by mtime)."""
+    if (
+        not force
+        and _LIB_PATH.exists()
+        and _LIB_PATH.stat().st_mtime >= _newest_source_mtime()
+    ):
+        return _LIB_PATH
+    cmd = [
+        "g++",
+        "-O3",
+        "-std=c++17",
+        "-shared",
+        "-fPIC",
+        "-pthread",
+        *[str(_SRC / s) for s in _SOURCES],
+        "-lz",
+        "-o",
+        str(_LIB_PATH),
+    ]
+    subprocess.run(cmd, check=True, capture_output=True)
+    return _LIB_PATH
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            return None
+        try:
+            path = build()
+            lib = ctypes.CDLL(str(path))
+        except Exception as e:
+            _build_failed = True
+            log.warning("native library unavailable: %s", e)
+            return None
+        lib.sbn_inflate_range.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sbn_inflate_range.restype = ctypes.c_int
+        lib.sbn_compress_bgzf.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sbn_compress_bgzf.restype = ctypes.c_int
+        lib.sbn_free.argtypes = [ctypes.POINTER(ctypes.c_uint8)]
+        lib.sbn_count_slice.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.sbn_count_slice.restype = ctypes.c_int
+        lib.sbn_line_offsets.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+        ]
+        lib.sbn_line_offsets.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None
+
+
+def prefer_native_io() -> bool:
+    """Whether the native BGZF codec should take over I/O paths: it wins
+    via block-parallel inflate, so a single-core host keeps python's
+    one-shot zlib (both are C underneath; the pool only adds overhead)."""
+    import os
+
+    return (os.cpu_count() or 1) >= 2 and available()
+
+
+def _take_buffer(lib, out_p, out_len) -> bytes:
+    try:
+        if not out_p or out_len.value == 0:
+            return b""
+        return ctypes.string_at(out_p, out_len.value)
+    finally:
+        if out_p:
+            lib.sbn_free(out_p)
+
+
+def inflate_range(
+    path: str | Path,
+    vstart: int = 0,
+    vend: int | None = None,
+    *,
+    n_threads: int | None = None,
+) -> bytes:
+    """Decompress the BGZF virtual-offset range [vstart, vend) — the
+    native VcfChunkReader role, blocks inflated in parallel (adaptive:
+    single-core machines take a pool-free reused-z_stream path)."""
+    if n_threads is None:
+        import os
+
+        n_threads = min(8, os.cpu_count() or 1)
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    out_p = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    rc = lib.sbn_inflate_range(
+        str(path).encode(),
+        vstart,
+        2**64 - 1 if vend is None else vend,
+        n_threads,
+        ctypes.byref(out_p),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_inflate_range failed rc={rc}")
+    return _take_buffer(lib, out_p, out_len)
+
+
+def compress_bgzf(data: bytes, level: int = 6) -> bytes:
+    """Full BGZF stream (blocks + EOF marker) for the given payload."""
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    out_p = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    buf = (ctypes.c_uint8 * len(data)).from_buffer_copy(data) if data else None
+    rc = lib.sbn_compress_bgzf(
+        buf, len(data), level, ctypes.byref(out_p), ctypes.byref(out_len)
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_compress_bgzf failed rc={rc}")
+    return _take_buffer(lib, out_p, out_len)
+
+
+def count_slice(text: bytes) -> tuple[int, int, int]:
+    """(num_variants, num_calls, num_records) over VCF body text — the
+    reference addCounts semantics (AC= commas / AN= value)."""
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    buf = (ctypes.c_uint8 * len(text)).from_buffer_copy(text) if text else None
+    nv = ctypes.c_int64()
+    nc = ctypes.c_int64()
+    nr = ctypes.c_int64()
+    rc = lib.sbn_count_slice(
+        buf, len(text), ctypes.byref(nv), ctypes.byref(nc), ctypes.byref(nr)
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_count_slice failed rc={rc}")
+    return nv.value, nc.value, nr.value

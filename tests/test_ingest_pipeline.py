@@ -211,6 +211,85 @@ def test_distinct_variant_count_unit():
         (r.chrom, r.pos, r.ref, a) for r in recs for a in r.alts
     }
     assert distinct_variant_count([s1, s2]) == len(brute)
+    # chunked path (tiny max_range_bytes forces many chunks) sums exactly
+    assert (
+        distinct_variant_count([s1, s2], max_range_bytes=256) == len(brute)
+    )
+
+
+def test_concurrent_summarisation_serialises(corpus):
+    """Two threads summarising the same VCF must not race: one does the
+    work, the other takes the finished-shard short-circuit."""
+    import threading
+
+    tmp_path, vcf, recs = corpus
+    pipe = _pipeline(tmp_path)
+    results = []
+    errors = []
+
+    def run():
+        try:
+            results.append(pipe.summarise_vcf("ds", str(vcf)))
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=run) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert len({s.n_rows for s in results}) == 1
+    want = build_index(
+        recs, dataset_id="ds", vcf_location=str(vcf), sample_names=["X", "Y", "Z"]
+    )
+    assert results[0].n_rows == want.n_rows
+    summary = pipe.ledger.vcf_summary(str(vcf))
+    # counts not double-added by the concurrent callers
+    assert summary["variant_count"] == want.n_rows
+
+
+def test_resume_uses_claimed_plan(corpus):
+    """Resume after a crash must use the slice plan stored at claim time,
+    even if the planner config drifted in between."""
+    import dataclasses
+
+    tmp_path, _, _ = corpus
+    # wide position span -> many linear-index boundaries -> plans that can
+    # actually differ between configs
+    rng = random.Random(31)
+    recs = random_records(rng, chrom="4", n=3000, n_samples=3, spacing=400)
+    vcf = tmp_path / "wide.vcf.gz"
+    write_vcf(vcf, recs, sample_names=["X", "Y", "Z"])
+    ensure_index(vcf)
+    pipe = _pipeline(tmp_path)
+    plan = plan_slices(ensure_index(vcf), pipe.config.ingest)
+    assert len(plan.slices) >= 2
+    # simulate a crashed run: claim exists, nothing completed
+    assert pipe.ledger.mark_updating(str(vcf), plan.slices)
+
+    # drift the config so a fresh plan would differ
+    drifted = dataclasses.replace(
+        pipe.config,
+        ingest=dataclasses.replace(
+            pipe.config.ingest,
+            min_task_time=100.0,
+            scan_rate=1e9,
+            dispatch_cost=10.0,
+        ),
+    )
+    pipe2 = SummarisationPipeline(
+        drifted, ledger=pipe.ledger, engine=None, store=None
+    )
+    assert plan_slices(ensure_index(vcf), drifted.ingest).slices != plan.slices
+    shard = pipe2.summarise_vcf("ds", str(vcf))
+    want = build_index(
+        recs, dataset_id="ds", vcf_location=str(vcf), sample_names=["X", "Y", "Z"]
+    )
+    assert shard.n_rows == want.n_rows
+    summary = pipe2.ledger.vcf_summary(str(vcf))
+    assert summary["pending"] == []
+    assert summary["variant_count"] == want.n_rows
 
 
 def test_chunk_boundaries_excludes_pseudobins(corpus):

@@ -1,0 +1,111 @@
+"""Native C++ library: BGZF codec + slice scanner parity vs the pure
+Python implementations."""
+
+import random
+
+import pytest
+
+from sbeacon_tpu import native
+from sbeacon_tpu.genomics.bgzf import (
+    BgzfReader,
+    make_virtual_offset,
+    scan_blocks,
+)
+from sbeacon_tpu.genomics.vcf import write_vcf
+from sbeacon_tpu.testing import random_records
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native toolchain unavailable"
+)
+
+
+@pytest.fixture(scope="module")
+def vcf(tmp_path_factory):
+    root = tmp_path_factory.mktemp("native")
+    rng = random.Random(4)
+    recs = []
+    for c in ("1", "2"):
+        recs.extend(random_records(rng, chrom=c, n=1500, n_samples=6))
+    path = root / "n.vcf.gz"
+    write_vcf(path, recs, sample_names=[f"S{i}" for i in range(6)])
+    return path, recs
+
+
+def test_inflate_full_parity(vcf):
+    path, _ = vcf
+    py = BgzfReader(path).read_all()
+    for nt in (1, 4):  # exercise both the pool and the pool-free path
+        assert native.inflate_range(path, n_threads=nt) == py
+
+
+def test_inflate_range_parity(vcf):
+    path, _ = vcf
+    blocks = scan_blocks(path)
+    assert len(blocks) >= 3
+    reader = BgzfReader(path)
+    cases = [
+        (make_virtual_offset(blocks[0][0], 10), make_virtual_offset(blocks[1][0], 0)),
+        (make_virtual_offset(blocks[1][0], 5), make_virtual_offset(blocks[2][0], 99)),
+        (make_virtual_offset(blocks[0][0], 0), make_virtual_offset(blocks[0][0], 123)),
+    ]
+    for vs, ve in cases:
+        assert native.inflate_range(path, vs, ve, n_threads=2) == reader.read_range(vs, ve)
+
+
+def test_compress_roundtrip(vcf):
+    path, _ = vcf
+    import gzip
+
+    raw = BgzfReader(path).read_all()
+    comp = native.compress_bgzf(raw)
+    assert gzip.decompress(comp) == raw
+    # the stream is valid BGZF: block headers parse + EOF marker present
+    p2 = path.parent / "rt.vcf.gz"
+    p2.write_bytes(comp)
+    assert BgzfReader(p2).read_all() == raw
+    assert comp.endswith(
+        bytes.fromhex(
+            "1f8b08040000000000ff0600424302001b0003000000000000000000"
+        )
+    )
+    assert native.compress_bgzf(b"") != b""
+
+
+def test_count_slice_reference_semantics(vcf):
+    path, recs = vcf
+    text = BgzfReader(path).read_all()
+    nv, nc, nr = native.count_slice(text)
+    # reference addCounts: variants counted only from AC= (1 + commas),
+    # calls only from AN= (summariseSlice/main.cpp:52-109)
+    assert nr == len(recs)
+    assert nv == sum(len(r.ac) for r in recs if r.ac is not None)
+    assert nc == sum(r.an for r in recs if r.an is not None)
+
+
+def test_count_slice_edge_cases():
+    # no trailing newline, header lines, missing AC/AN
+    text = (
+        b"##header\n"
+        b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\n"
+        b"1\t100\t.\tA\tT,G\t.\tPASS\tAC=5,7;AN=20\n"
+        b"1\t200\t.\tC\tG\t.\tPASS\tDP=3\n"
+        b"1\t300\t.\tG\tA\t.\tPASS\tAN=8;AC=2"
+    )
+    nv, nc, nr = native.count_slice(text)
+    assert (nv, nc, nr) == (3, 28, 3)
+
+
+def test_reader_uses_native_when_preferred(vcf, monkeypatch):
+    path, _ = vcf
+    if not native.prefer_native_io():
+        pytest.skip("single-core host: python path preferred")
+    called = {}
+    real = native.inflate_range
+
+    def spy(*a, **kw):
+        called["yes"] = True
+        return real(*a, **kw)
+
+    monkeypatch.setattr(native, "inflate_range", spy)
+    BgzfReader(path).read_all()
+    assert called
