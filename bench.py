@@ -1,7 +1,16 @@
-"""Headline benchmark: batched Beacon point-query throughput on one chip.
+"""Headline benchmark + BASELINE.md config suite.
 
-BASELINE.md config 2 — "10k batched SNV point queries, single dataset" —
-answered by the vmap'd sorted-index kernel (sbeacon_tpu/ops/kernel.py).
+Prints ONE JSON line. The headline metric is BASELINE config 2 ("10k
+batched SNV point queries, single dataset" on one chip); the other four
+configs from BASELINE.md ride in ``detail``:
+
+  1. single SNV exists-query latency (p50) + allele-count parity vs the
+     CPU oracle (the performQuery-equivalent semantics spec),
+  2. 10k batched point queries (headline),
+  3. start-end bracket/range queries across chr1..22,
+  4. multi-dataset aggregation (dataset-sharded engine fan-in + distinct
+     variant parity),
+  5. structural-variant / INDEL overlap queries (variantType matching).
 
 Baseline derivation (the reference publishes no numbers — BASELINE.md):
 the reference answers each point query with a splitQuery->performQuery
@@ -11,9 +20,6 @@ variantutils/search_variants.py THREADS=500) and whose per-query
 end-to-end latency is ~1 s (bcftools region scan + invoke overhead at the
 reference's assumed 75 MB/s scan rate, summariseVcf:23). Ceiling ~= 1000
 queries/sec. ``vs_baseline`` is measured-qps / 1000.
-
-Prints ONE JSON line:
-  {"metric": ..., "value": N, "unit": "queries/sec", "vs_baseline": N}
 """
 
 from __future__ import annotations
@@ -22,22 +28,25 @@ import json
 import random
 import time
 
-import numpy as np
-
 N_RECORDS = 60_000
 N_QUERIES = 10_000
 REPEATS = 5
 BASELINE_QPS = 1000.0
 
+ALL_CHROMS = [str(i) for i in range(1, 23)]
 
-def main() -> None:
+
+def _time_batch(fn, repeats=REPEATS):
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def build_corpus():
     from sbeacon_tpu.index.columnar import build_index
-    from sbeacon_tpu.ops.kernel import (
-        DeviceIndex,
-        QuerySpec,
-        encode_queries,
-        run_queries,
-    )
     from sbeacon_tpu.testing import random_records
 
     rng = random.Random(7)
@@ -49,9 +58,19 @@ def main() -> None:
             )
         )
     shard = build_index(records, dataset_id="bench", with_genotypes=False)
-    dindex = DeviceIndex(shard)
+    return records, shard
 
-    # point queries: half exact hits sampled from the index, half misses
+
+def config2_point_queries(shard):
+    """Headline: 10k batched point queries, single chip."""
+    from sbeacon_tpu.ops.kernel import (
+        DeviceIndex,
+        QuerySpec,
+        encode_queries,
+        run_queries,
+    )
+
+    dindex = DeviceIndex(shard)
     qrng = random.Random(11)
     specs = []
     n_rows = shard.n_rows
@@ -76,19 +95,222 @@ def main() -> None:
                 QuerySpec("1", pos, pos, 1, 2**30, alternate_bases="T")
             )
     enc = encode_queries(specs)
-
-    # warm-up compiles the kernel
-    res = run_queries(dindex, enc, window_cap=512, record_cap=64)
+    res = run_queries(dindex, enc, window_cap=512, record_cap=64)  # warm-up
     n_hits = int(res.exists.sum())
+    best = _time_batch(
+        lambda: run_queries(dindex, enc, window_cap=512, record_cap=64)
+    )
+    return N_QUERIES / best, {"hits": n_hits, "best_batch_s": round(best, 4)}
 
-    times = []
-    for _ in range(REPEATS):
+
+def config1_single_snv(records, shard):
+    """Single SNV exists-query p50 latency + oracle parity."""
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.oracle import oracle_search
+    from sbeacon_tpu.payloads import VariantQueryPayload
+
+    engine = VariantEngine()
+    engine.add_index(shard)
+    rng = random.Random(23)
+    hits = [r for r in records if not r.alts[0].startswith("<")]
+    lat = []
+    parity_ok = 0
+    n_checks = 40
+    for _ in range(n_checks):
+        rec = rng.choice(hits)
+        payload = VariantQueryPayload(
+            dataset_ids=["bench"],
+            reference_name=rec.chrom,
+            start_min=rec.pos,
+            start_max=rec.pos,
+            end_min=1,
+            end_max=2**30,
+            reference_bases=rec.ref.upper(),
+            alternate_bases=rec.alts[0].upper(),
+            requested_granularity="record",
+            include_datasets="HIT",
+        )
         t0 = time.perf_counter()
-        run_queries(dindex, enc, window_cap=512, record_cap=64)
-        times.append(time.perf_counter() - t0)
-    best = min(times)
-    qps = N_QUERIES / best
+        got = engine.search(payload)
+        lat.append(time.perf_counter() - t0)
+        want = oracle_search(
+            records,
+            first_bp=rec.pos,
+            last_bp=rec.pos,
+            end_min=1,
+            end_max=2**30,
+            reference_bases=rec.ref.upper(),
+            alternate_bases=rec.alts[0].upper(),
+            requested_granularity="record",
+            include_details=True,
+            dataset_id="bench",
+            chrom_label=rec.chrom,
+        )
+        if (
+            got
+            and got[0].exists == want.exists
+            and got[0].call_count == want.call_count
+            and got[0].all_alleles_count == want.all_alleles_count
+        ):
+            parity_ok += 1
+    lat.sort()
+    return {
+        "p50_ms": round(lat[len(lat) // 2] * 1000, 3),
+        "allele_count_parity": f"{parity_ok}/{n_checks}",
+    }
 
+
+def config3_bracket_ranges():
+    """Bracket/range queries across chr1..22 (own whole-genome corpus)."""
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ops.kernel import (
+        DeviceIndex,
+        QuerySpec,
+        encode_queries,
+        run_queries,
+    )
+    from sbeacon_tpu.testing import random_records
+
+    rng = random.Random(3)
+    records = []
+    per = 4_000
+    for chrom in ALL_CHROMS:
+        records.extend(
+            random_records(rng, chrom=chrom, n=per, n_samples=4, spacing=200)
+        )
+    shard = build_index(records, dataset_id="wg", with_genotypes=False)
+    dindex = DeviceIndex(shard)
+    qrng = random.Random(5)
+    n_q = 4_000
+    specs = []
+    for _ in range(n_q):
+        chrom = qrng.choice(ALL_CHROMS)
+        a = qrng.randrange(1, per * 200)
+        specs.append(
+            QuerySpec(
+                chrom,
+                max(1, a - 2_000),
+                a + 2_000,
+                a,
+                a + 6_000,
+                alternate_bases="N",
+            )
+        )
+    enc = encode_queries(specs)
+    res = run_queries(dindex, enc, window_cap=512, record_cap=64)
+    best = _time_batch(
+        lambda: run_queries(dindex, enc, window_cap=512, record_cap=64)
+    )
+    return {
+        "qps": round(n_q / best, 1),
+        "n_queries": n_q,
+        "index_rows": shard.n_rows,
+        "hits": int(res.exists.sum()),
+    }
+
+
+def config4_multi_dataset():
+    """Multi-dataset aggregation + distinct-variant parity (own corpus)."""
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ingest.pipeline import distinct_variant_count
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
+
+    rng = random.Random(17)
+    engine = VariantEngine()
+    shards = []
+    n_ds = 8
+    for d in range(n_ds):
+        recs = random_records(rng, chrom="9", n=3_000, n_samples=4)
+        shard = build_index(recs, dataset_id=f"d{d}", with_genotypes=False)
+        shards.append((recs, shard))
+        engine.add_index(shard)
+
+    payload = VariantQueryPayload(
+        dataset_ids=[f"d{d}" for d in range(n_ds)],
+        reference_name="9",
+        start_min=1,
+        start_max=10**8,
+        end_min=1,
+        end_max=2**30,
+        alternate_bases="N",
+        requested_granularity="record",
+        include_datasets="HIT",
+    )
+    responses = engine.search(payload)  # warm
+    best = _time_batch(lambda: engine.search(payload), repeats=3)
+    distinct = distinct_variant_count([s for _, s in shards])
+    brute = {
+        (r.chrom, r.pos, r.ref, a)
+        for recs, _ in shards
+        for r in recs
+        for a in r.alts
+    }
+    return {
+        "n_datasets": n_ds,
+        "aggregate_s": round(best, 4),
+        "responses": len(responses),
+        "total_calls": int(sum(r.call_count for r in responses)),
+        "distinct_variants": distinct,
+        "distinct_parity": distinct == len(brute),
+    }
+
+
+def config5_sv_indel(records, shard):
+    """Structural-variant / INDEL overlap queries (variantType matching)."""
+    from sbeacon_tpu.ops.kernel import (
+        DeviceIndex,
+        QuerySpec,
+        encode_queries,
+        run_queries,
+    )
+
+    dindex = DeviceIndex(shard)
+    qrng = random.Random(29)
+    n_q = 2_000
+    span = int(shard.cols["pos"].max())  # keep queries inside the corpus
+    specs = []
+    for _ in range(n_q):
+        a = qrng.randrange(1, span)
+        vt = qrng.choice(["DEL", "INS", "DUP", "DUP:TANDEM", "CNV"])
+        specs.append(
+            QuerySpec(
+                qrng.choice(("1", "22")),
+                max(1, a - 5_000),
+                a + 5_000,
+                1,
+                2**30,
+                variant_type=vt,
+                variant_min_length=0,
+                variant_max_length=-1,
+            )
+        )
+    enc = encode_queries(specs)
+    res = run_queries(dindex, enc, window_cap=512, record_cap=64)
+    best = _time_batch(
+        lambda: run_queries(dindex, enc, window_cap=512, record_cap=64)
+    )
+    return {
+        "qps": round(n_q / best, 1),
+        "n_queries": n_q,
+        "hits": int(res.exists.sum()),
+    }
+
+
+def main() -> None:
+    records, shard = build_corpus()
+
+    qps, d2 = config2_point_queries(shard)
+    detail = {
+        "n_queries": N_QUERIES,
+        "index_rows": shard.n_rows,
+        **d2,
+        "config1_single_snv": config1_single_snv(records, shard),
+        "config3_bracket_chr1_22": config3_bracket_ranges(),
+        "config4_multi_dataset": config4_multi_dataset(),
+        "config5_sv_indel": config5_sv_indel(records, shard),
+    }
     print(
         json.dumps(
             {
@@ -96,12 +318,7 @@ def main() -> None:
                 "value": round(qps, 1),
                 "unit": "queries/sec",
                 "vs_baseline": round(qps / BASELINE_QPS, 2),
-                "detail": {
-                    "n_queries": N_QUERIES,
-                    "index_rows": n_rows,
-                    "best_batch_s": round(best, 4),
-                    "hits": n_hits,
-                },
+                "detail": detail,
             }
         )
     )

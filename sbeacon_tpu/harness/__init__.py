@@ -1,0 +1,4 @@
+from .simulate import populate, random_submission
+from .latency import run_latency_suite
+
+__all__ = ["populate", "random_submission", "run_latency_suite"]

@@ -1,0 +1,49 @@
+"""Simulation + latency harness: populate through the real /submit path,
+then walk every endpoint over live HTTP."""
+
+import pytest
+
+from sbeacon_tpu.api import BeaconApp
+from sbeacon_tpu.api.server import start_background
+from sbeacon_tpu.config import BeaconConfig, StorageConfig
+from sbeacon_tpu.harness import populate, run_latency_suite
+
+
+@pytest.fixture(scope="module")
+def live(tmp_path_factory):
+    root = tmp_path_factory.mktemp("sim")
+    config = BeaconConfig(storage=StorageConfig(root=root / "data"))
+    config.storage.ensure()
+    app = BeaconApp(config)
+    recs = populate(
+        app,
+        root / "vcfs",
+        n_datasets=2,
+        n_individuals=5,
+        records_per_chrom=150,
+    )
+    server, _ = start_background(app)
+    url = f"http://127.0.0.1:{server.server_address[1]}"
+    yield app, url, recs
+    server.shutdown()
+    server.server_close()
+
+
+def test_populate_created_everything(live):
+    app, _, recs = live
+    assert set(recs) == {"sim0", "sim1"}
+    assert app.store.count("datasets") == 2
+    assert app.store.count("individuals") == 10
+    assert app.store.count("analyses") == 10
+    assert len(app.engine.datasets()) == 2
+    job = app.ingest.ledger.dataset_job("sim1")
+    assert job["state"] == "complete"
+    assert job["variant_count"] > 0
+
+
+def test_latency_suite_all_green(live):
+    _, url, _ = live
+    results = run_latency_suite(url, reps=2)
+    # every check ran and returned a sane latency
+    assert len(results) >= 18
+    assert all(0 <= t < 30 for t in results.values())
