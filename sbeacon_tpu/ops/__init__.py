@@ -5,11 +5,19 @@ from .kernel import (
     encode_queries,
     run_queries,
 )
+from .pallas_kernel import (
+    HAVE_PALLAS,
+    PallasDeviceIndex,
+    run_queries_pallas,
+)
 
 __all__ = [
     "DeviceIndex",
+    "HAVE_PALLAS",
+    "PallasDeviceIndex",
     "QueryResults",
     "QuerySpec",
     "encode_queries",
     "run_queries",
+    "run_queries_pallas",
 ]
