@@ -26,7 +26,9 @@ from __future__ import annotations
 
 import json
 import random
+import sys
 import time
+import traceback
 
 N_RECORDS = 60_000
 N_QUERIES = 10_000
@@ -77,13 +79,25 @@ def _timed_best(shard, dindex, enc, ref_results, *, window):
         if HAVE_PALLAS:
             pindex = PallasDeviceIndex(shard, window=window)
             got = run_queries_pallas(pindex, enc)  # warm-up + parity guard
-            if (got["exists"] == ref_results.exists).all() and not got[
-                "overflow"
-            ].any():
+            parity = (
+                (got["exists"] == ref_results.exists).all()
+                and (got["call_count"] == ref_results.call_count).all()
+                and (got["n_variants"] == ref_results.n_variants).all()
+                and (
+                    got["all_alleles_count"] == ref_results.all_alleles_count
+                ).all()
+                and not got["overflow"].any()
+            )
+            if parity:
                 best = _time_batch(lambda: run_queries_pallas(pindex, enc))
                 return best, "pallas"
+            print(
+                "bench: pallas kernel failed parity guard; using xla",
+                file=sys.stderr,
+            )
     except Exception:
-        pass
+        traceback.print_exc(file=sys.stderr)
+        print("bench: pallas path unavailable; using xla", file=sys.stderr)
     best = _time_batch(
         lambda: run_queries(dindex, enc, window_cap=window, record_cap=64)
     )

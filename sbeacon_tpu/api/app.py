@@ -26,6 +26,7 @@ from ..ingest import IngestService
 from ..ingest.service import VcfLocationError
 from ..metadata import MetadataStore, OntologyStore
 from ..metadata.filters import FilterError
+from ..utils.trace import span, tracer
 from .envelopes import Envelopes
 from .framework import (
     configuration_response,
@@ -135,7 +136,8 @@ class BeaconApp:
         body: dict | None = None,
     ) -> tuple[int, dict]:
         try:
-            return self._route(method.upper(), path, query_params, body)
+            with span("api.handle", path=path, method=method):
+                return self._route(method.upper(), path, query_params, body)
         except (RequestError, FilterError, VcfLocationError) as e:
             return 400, self.env.error(400, str(e))
         except Exception as e:  # pragma: no cover - defensive 500
@@ -151,6 +153,11 @@ class BeaconApp:
             return 200, info_response(info)
         head = parts[0]
         if len(parts) == 1:
+            if head == "_trace":
+                # debug-only profiling surface; 404s unless tracing is on
+                if not tracer.is_enabled:
+                    return 404, self.env.error(404, "tracing disabled")
+                return 200, {"report": tracer.report()}
             if head == "configuration":
                 return 200, configuration_response(info)
             if head == "map":

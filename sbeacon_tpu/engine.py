@@ -34,6 +34,7 @@ from .index.columnar import FLAG, VariantIndexShard
 from .ops.kernel import DeviceIndex, QuerySpec, run_queries
 from .payloads import VariantQueryPayload, VariantSearchResponse
 from .utils.chrom import chromosome_code
+from .utils.trace import span
 
 # uppercase LUT for vectorised case-insensitive byte compares
 _UPPER = np.arange(256, dtype=np.uint8)
@@ -358,6 +359,11 @@ class VariantEngine:
         """One response per (dataset, vcf) — the PerformQueryResponse set the
         reference's fan-in assembles (search_variants.py:130-155), computed
         without any fan-out machinery."""
+        with span("engine.search") as sp:
+            responses = self._search(payload, sp)
+        return responses
+
+    def _search(self, payload: VariantQueryPayload, sp):
         eng = self.config.engine
         spec_base = QuerySpec(
             chrom=payload.reference_name,
@@ -420,4 +426,5 @@ class VariantEngine:
                     selected_idx=selected_idx,
                 )
             )
+        sp.note(targets=len(targets), responses=len(responses))
         return responses

@@ -28,6 +28,7 @@ from ..config import BeaconConfig
 from ..genomics.bgzf import BgzfReader
 from ..genomics.tabix import ensure_index
 from ..genomics.vcf import parse_record, read_sample_names
+from ..utils.trace import span
 from ..index.columnar import (
     VariantIndexShard,
     build_index,
@@ -125,8 +126,9 @@ class SummarisationPipeline:
         shards are reused). Concurrent in-process calls for the same VCF
         serialise on a lock — the second caller then takes the finished-
         shard short-circuit."""
-        with self._vcf_lock(vcf):
-            return self._summarise_vcf_locked(dataset_id, vcf)
+        with span("ingest.summarise_vcf", vcf=str(vcf)):
+            with self._vcf_lock(vcf):
+                return self._summarise_vcf_locked(dataset_id, vcf)
 
     def _summarise_vcf_locked(
         self, dataset_id: str, vcf: str

@@ -42,6 +42,7 @@ from ..index.columnar import (
     prefix_mask,
 )
 from ..utils.chrom import chromosome_code
+from ..utils.trace import span
 
 # variant_type codes for the type-dispatch mode
 VT_DEL, VT_INS, VT_DUP, VT_DUP_TANDEM, VT_CNV, VT_OTHER = range(6)
@@ -357,15 +358,17 @@ def run_queries(
     enc = (
         encode_queries(queries) if isinstance(queries, list) else queries
     )
-    enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
-    out = _query_batch(
-        dindex.arrays,
-        enc_dev,
-        window_cap=window_cap,
-        record_cap=record_cap,
-        n_iters=dindex.n_iters,
-    )
-    out = jax.device_get(out)
+    with span("kernel.run_queries") as sp:
+        enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
+        out = _query_batch(
+            dindex.arrays,
+            enc_dev,
+            window_cap=window_cap,
+            record_cap=record_cap,
+            n_iters=dindex.n_iters,
+        )
+        out = jax.device_get(out)
+        sp.note(batch=int(enc["chrom"].shape[0]))
     return QueryResults(
         exists=np.asarray(out["exists"]),
         call_count=np.asarray(out["call_count"]),

@@ -1,0 +1,241 @@
+"""Flag-gated hierarchical tracing / profiling.
+
+The reference's observability is a compile-gated C++ stopwatch
+(reference: lambda/summariseSlice/source/stopwatch.h, enabled by
+``#define INCLUDE_STOP_WATCH`` at main.cpp:33 with throughput prints at
+main.cpp:238-241), a ``timeit`` decorator in the latency harness
+(simulations/test.py:16-23), and print-to-CloudWatch logging everywhere
+else — SURVEY.md §5 calls for proper timers around kernels and host RPC
+spans, kept flag-gated so the hot path pays nothing when disabled.
+
+Design: one process-global :class:`Tracer` holding a thread-local span
+stack. ``span("name")`` is a context manager (use ``tracer.wrap(name)``
+for the decorator form); nested spans record parent-child structure.
+When disabled (the default, like the reference's undefined
+INCLUDE_STOP_WATCH) ``span`` returns a no-op singleton — no allocation,
+no clock read. Enable via ``SBEACON_TRACE=1``, ``tracer.enable()``, or
+the thread-scoped ``enabled(True)`` override. Finished spans aggregate
+into per-name statistics (count / total / min / max) and retain the
+most recent N complete span trees; ``report()`` renders both, and a
+process enabled via ``SBEACON_TRACE=1`` prints the report to stderr at
+exit (the stopwatch-print role of reference main.cpp:238-241).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+@dataclass(eq=False)  # identity equality: `in`-checks on the span stack
+class Span:
+    """One finished timed region. ``children`` preserves call structure."""
+
+    name: str
+    t_start: float
+    t_end: float = 0.0
+    meta: dict = field(default_factory=dict)
+    children: list["Span"] = field(default_factory=list)
+
+    @property
+    def elapsed(self) -> float:
+        return self.t_end - self.t_start
+
+    def flatten(self):
+        yield self
+        for c in self.children:
+            yield from c.flatten()
+
+
+class _NullSpan:
+    """No-op context manager handed out while tracing is disabled."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def note(self, **kw):
+        pass
+
+
+_NULL = _NullSpan()
+
+
+class _ActiveSpan:
+    __slots__ = ("tracer", "span")
+
+    def __init__(self, tracer: "Tracer", span: Span):
+        self.tracer = tracer
+        self.span = span
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.tracer._finish(self.span)
+        return False
+
+    def note(self, **kw):
+        """Attach metadata (bytes scanned, batch size, ...) to the span."""
+        self.span.meta.update(kw)
+
+
+class Tracer:
+    def __init__(self, enabled: bool | None = None, keep_trees: int = 32):
+        if enabled is None:
+            enabled = os.environ.get("SBEACON_TRACE", "") not in ("", "0")
+        self._enabled = enabled
+        self._keep_trees = keep_trees
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        # name -> [count, total, min, max]
+        self.stats: dict[str, list[float]] = {}
+        self.trees: list[Span] = []
+
+    # -- gating -------------------------------------------------------------
+
+    @property
+    def is_enabled(self) -> bool:
+        override = getattr(self._local, "override", None)
+        return self._enabled if override is None else override
+
+    def enable(self) -> None:
+        self._enabled = True
+
+    def disable(self) -> None:
+        self._enabled = False
+
+    @contextmanager
+    def enabled(self, on: bool = True):
+        """Thread-scoped override: ``with tracer.enabled(): ...``. The
+        override lives in thread-local state so concurrent scopes in other
+        threads neither see it nor clobber the process-wide flag."""
+        prev = getattr(self._local, "override", None)
+        self._local.override = on
+        try:
+            yield self
+        finally:
+            self._local.override = prev
+
+    # -- span recording -----------------------------------------------------
+
+    def span(self, name: str, **meta):
+        if not self.is_enabled:
+            return _NULL
+        sp = Span(name=name, t_start=time.perf_counter(), meta=dict(meta))
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        stack.append(sp)
+        return _ActiveSpan(self, sp)
+
+    def _finish(self, sp: Span) -> None:
+        sp.t_end = time.perf_counter()
+        stack = self._local.stack
+        was_root = False
+        if sp in stack:
+            # spans still open above sp were opened inside its scope: a
+            # mis-ordered exit adopts them as children rather than
+            # discarding them (or sp's own ancestors)
+            while stack[-1] is not sp:
+                sp.children.append(stack.pop())
+            stack.pop()
+            if stack:
+                stack[-1].children.append(sp)
+            else:
+                was_root = True
+        # else: sp was already adopted by a mis-ordered ancestor exit —
+        # record stats only, leave the stack alone
+        with self._lock:
+            st = self.stats.get(sp.name)
+            el = sp.elapsed
+            if st is None:
+                self.stats[sp.name] = [1, el, el, el]
+            else:
+                st[0] += 1
+                st[1] += el
+                st[2] = min(st[2], el)
+                st[3] = max(st[3], el)
+            if was_root:  # a completed root tree
+                self.trees.append(sp)
+                if len(self.trees) > self._keep_trees:
+                    del self.trees[: -self._keep_trees]
+
+    def wrap(self, name: str | None = None):
+        """Decorator form: ``@tracer.wrap("kernel.run")``."""
+
+        def deco(fn):
+            label = name or fn.__qualname__
+
+            @functools.wraps(fn)
+            def inner(*a, **kw):
+                with self.span(label):
+                    return fn(*a, **kw)
+
+            return inner
+
+        return deco
+
+    # -- reporting ----------------------------------------------------------
+
+    def reset(self) -> None:
+        with self._lock:
+            self.stats.clear()
+            self.trees.clear()
+
+    def report(self) -> str:
+        """Aggregate table + the most recent span tree."""
+        with self._lock:
+            lines = [
+                f"{'span':<40} {'count':>7} {'total_s':>10} "
+                f"{'mean_ms':>9} {'min_ms':>9} {'max_ms':>9}"
+            ]
+            for name in sorted(self.stats):
+                n, tot, mn, mx = self.stats[name]
+                lines.append(
+                    f"{name:<40} {int(n):>7} {tot:>10.4f} "
+                    f"{1e3 * tot / n:>9.3f} {1e3 * mn:>9.3f} {1e3 * mx:>9.3f}"
+                )
+            if self.trees:
+                lines.append("")
+                lines.extend(self._render(self.trees[-1], 0))
+        return "\n".join(lines)
+
+    def _render(self, sp: Span, depth: int):
+        meta = (
+            " " + " ".join(f"{k}={v}" for k, v in sp.meta.items())
+            if sp.meta
+            else ""
+        )
+        yield f"{'  ' * depth}{sp.name}: {1e3 * sp.elapsed:.3f}ms{meta}"
+        for c in sp.children:
+            yield from self._render(c, depth + 1)
+
+
+#: process-global tracer — modules do ``from ..utils.trace import tracer``
+tracer = Tracer()
+
+if tracer.is_enabled:
+    # enabled-by-env processes print the aggregate report at exit, so
+    # SBEACON_TRACE=1 always yields output even without the /_trace route
+    import atexit
+    import sys
+
+    atexit.register(
+        lambda: print(
+            "\n== sbeacon trace report ==\n" + tracer.report(),
+            file=sys.stderr,
+        )
+    )
+
+
+def span(name: str, **meta):
+    return tracer.span(name, **meta)

@@ -1,0 +1,118 @@
+"""Tracing subsystem: gating, nesting, stats, thread isolation."""
+
+import threading
+
+from sbeacon_tpu.utils.trace import Tracer, tracer
+
+
+def test_disabled_is_noop():
+    t = Tracer(enabled=False)
+    with t.span("a") as sp:
+        sp.note(x=1)  # must not raise on the null span
+    assert t.stats == {}
+    assert t.trees == []
+
+
+def test_nesting_and_stats():
+    t = Tracer(enabled=True)
+    with t.span("outer"):
+        with t.span("inner"):
+            pass
+        with t.span("inner"):
+            pass
+    assert t.stats["inner"][0] == 2
+    assert t.stats["outer"][0] == 1
+    (tree,) = t.trees
+    assert tree.name == "outer"
+    assert [c.name for c in tree.children] == ["inner", "inner"]
+    assert tree.elapsed >= sum(c.elapsed for c in tree.children)
+
+
+def test_meta_and_report():
+    t = Tracer(enabled=True)
+    with t.span("q", path="/g_variants") as sp:
+        sp.note(batch=17)
+    rep = t.report()
+    assert "q" in rep and "batch=17" in rep and "path=/g_variants" in rep
+
+
+def test_scoped_enable_on_global():
+    tracer.reset()
+    assert not tracer.is_enabled
+    with tracer.enabled():
+        with tracer.span("scoped"):
+            pass
+    assert not tracer.is_enabled
+    assert "scoped" in tracer.stats
+    tracer.reset()
+
+
+def test_thread_local_stacks():
+    t = Tracer(enabled=True)
+    barrier = threading.Barrier(2)
+
+    def work(name):
+        with t.span(name):
+            barrier.wait()  # both threads hold an open root span at once
+            with t.span(f"{name}.child"):
+                pass
+
+    threads = [threading.Thread(target=work, args=(f"t{i}",)) for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    # each thread produced its own root tree with exactly its own child
+    assert len(t.trees) == 2
+    for tree in t.trees:
+        assert [c.name for c in tree.children] == [f"{tree.name}.child"]
+
+
+def test_wrap_decorator():
+    t = Tracer(enabled=True)
+
+    @t.wrap("fn.label")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2
+    assert t.stats["fn.label"][0] == 1
+
+
+def test_misnested_exit_adopts_children():
+    t = Tracer(enabled=True)
+    r = t.span("R")
+    a = t.span("A")
+    b = t.span("B")
+    a.__exit__(None, None, None)  # A exits before B
+    b.__exit__(None, None, None)  # B already adopted: stats only
+    r.__exit__(None, None, None)
+    assert t.stats["B"][0] == 1
+    (tree,) = t.trees  # R is the only root tree
+    assert tree.name == "R"
+    assert [c.name for c in tree.children] == ["A"]
+    assert [c.name for c in tree.children[0].children] == ["B"]
+
+
+def test_scoped_override_is_thread_local():
+    t = Tracer(enabled=False)
+    seen = {}
+
+    def other():
+        seen["other_enabled"] = t.is_enabled
+
+    with t.enabled():
+        th = threading.Thread(target=other)
+        th.start()
+        th.join()
+        assert t.is_enabled
+    assert seen["other_enabled"] is False
+    assert not t.is_enabled
+
+
+def test_keep_trees_bounded():
+    t = Tracer(enabled=True, keep_trees=3)
+    for _ in range(10):
+        with t.span("r"):
+            pass
+    assert len(t.trees) == 3
