@@ -26,6 +26,7 @@ from ..ingest import IngestService
 from ..ingest.service import VcfLocationError
 from ..metadata import MetadataStore, OntologyStore
 from ..metadata.filters import FilterError
+from ..query_jobs import AsyncQueryRunner, QueryJobTable
 from ..utils.trace import span, tracer
 from .envelopes import Envelopes
 from .framework import (
@@ -125,6 +126,16 @@ class BeaconApp:
             self.config, engine=self.engine, store=self.store
         )
         self.env = Envelopes(self.config.info)
+        # async query job table (VariantQueries/VariantQueryResponses roles):
+        # coalesces concurrent identical queries, caches results for the
+        # query TTL, spills oversized response sets to query_results_dir
+        storage.ensure()
+        self.query_jobs = QueryJobTable(
+            storage.root / "query-jobs.sqlite",
+            spill_dir=storage.query_results_dir,
+            inline_limit=self.config.engine.max_response_inline_bytes,
+        )
+        self.query_runner = AsyncQueryRunner(self.engine, self.query_jobs)
 
     # -- transport-facing entry --------------------------------------------
 
@@ -307,6 +318,7 @@ class BeaconApp:
             end_min=end_min,
             end_max=end_max,
             samples_by_dataset=samples,
+            runner=self.query_runner,
         )
         return 200, self.env.by_granularity(
             req.granularity,
@@ -339,6 +351,7 @@ class BeaconApp:
             alternate_bases=alt,
             samples_by_dataset=samples,
             include_resultset_responses="ALL",
+            runner=self.query_runner,
         )
         return 200, self.env.by_granularity(
             req.granularity,
@@ -378,6 +391,7 @@ class BeaconApp:
             reference_bases=ref,
             alternate_bases=alt,
             include_resultset_responses="ALL",
+            runner=self.query_runner,
         )
         docs: list[dict] = []
         for ds_id, names in sorted(agg.sample_names_by_dataset.items()):
@@ -443,6 +457,7 @@ class BeaconApp:
             end_min=end_min,
             end_max=end_max,
             samples_by_dataset=samples,
+            runner=self.query_runner,
         )
         return 200, self.env.by_granularity(
             req.granularity,

@@ -145,8 +145,15 @@ def run_variant_search(
     variant_type: str | None = None,
     samples_by_dataset: dict[str, list[str]] | None = None,
     include_resultset_responses: str | None = None,
+    runner=None,
 ) -> VariantAggregation:
-    """Dispatch one search over the resolved datasets and aggregate."""
+    """Dispatch one search over the resolved datasets and aggregate.
+
+    With ``runner`` (an ``AsyncQueryRunner``) the search goes through the
+    query job table: concurrent identical queries coalesce onto one
+    execution and completed results are served from the TTL'd cache — the
+    caching the reference stubs out (variant_queries.py:94-103 "TODO
+    implement caching"). Without it, a direct engine call."""
     reference_name = (
         reference_name if reference_name is not None else req.reference_name
     )
@@ -193,9 +200,34 @@ def run_variant_search(
         sample_names=samples_by_dataset if selected else {},
         selected_samples_only=selected,
     )
+    if runner is not None:
+        from ..query_jobs import JobStatus
+
+        query_id, _ = runner.submit(
+            payload, fingerprint=engine.index_fingerprint()
+        )
+        responses = runner.result(
+            query_id, wait_s=engine.config.engine.request_timeout_s
+        )
+        if responses is None:
+            if runner.poll(query_id) is JobStatus.RUNNING:
+                # still executing past request_timeout_s: starting a second
+                # identical search would double device load exactly when
+                # the engine is slowest — report the timeout instead (the
+                # reference's REQUEST_TIMEOUT gives up the same way,
+                # variantutils/search_variants.py:134-141)
+                raise TimeoutError(
+                    f"variant query {query_id} timed out after "
+                    f"{engine.config.engine.request_timeout_s}s"
+                )
+            # job abandoned (worker failed): run directly so the real
+            # error surfaces to this caller
+            responses = engine.search(payload)
+    else:
+        responses = engine.search(payload)
     agg = VariantAggregation(req.assembly_id or "")
     agg.add(
-        engine.search(payload),
+        responses,
         granularity=req.granularity,
         check_all=check_all,
     )

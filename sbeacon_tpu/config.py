@@ -107,6 +107,13 @@ class EngineConfig:
     request_timeout_s: float = 600.0  # variantutils REQUEST_TIMEOUT
     mesh_axis: str = "d"
     use_tpu: bool = True
+    # serving micro-batcher (SURVEY.md §7): with wait=0 the leader runs
+    # immediately and batches form from requests queuing behind an
+    # in-flight kernel launch (continuous batching); raise wait_ms to
+    # trade single-query latency for fuller batches
+    microbatch: bool = True
+    microbatch_max: int = 512
+    microbatch_wait_ms: float = 0.0
 
 
 @dataclasses.dataclass(frozen=True)

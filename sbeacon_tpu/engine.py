@@ -325,6 +325,16 @@ class VariantEngine:
         self.config = config or BeaconConfig()
         # (dataset_id, vcf_location) -> (shard, DeviceIndex)
         self._indexes: dict[tuple[str, str], tuple[VariantIndexShard, DeviceIndex]] = {}
+        eng = self.config.engine
+        if eng.microbatch:
+            from .serving import MicroBatcher
+
+            self._batcher = MicroBatcher(
+                max_batch=eng.microbatch_max,
+                max_wait_ms=eng.microbatch_wait_ms,
+            )
+        else:
+            self._batcher = None
 
     # -- index management ---------------------------------------------------
 
@@ -347,6 +357,17 @@ class VariantEngine:
 
     def datasets(self) -> list[str]:
         return sorted({ds for ds, _ in self._indexes})
+
+    def index_fingerprint(self) -> str:
+        """Identity of the loaded index set; folds into async-query cache
+        keys so cached results are invalidated by any (re-)ingestion."""
+        parts = []
+        for (ds, vcf), (shard, _) in sorted(self._indexes.items()):
+            parts.append(
+                f"{ds}|{vcf}|{shard.meta.get('variant_count')}"
+                f"|{shard.meta.get('call_count')}|{shard.n_rows}"
+            )
+        return "&".join(parts)
 
     def indexes_for(self, dataset_ids: list[str]):
         for (ds, vcf), pair in sorted(self._indexes.items()):
@@ -405,12 +426,22 @@ class VariantEngine:
             elif dindex is None:
                 rows = host_match_rows(shard, spec_base)
             else:
-                res = run_queries(
-                    dindex,
-                    [spec_base],
-                    window_cap=eng.window_cap,
-                    record_cap=eng.record_cap,
-                )
+                if self._batcher is not None:
+                    # concurrent searches against this shard coalesce into
+                    # one kernel launch (serving micro-batcher, SURVEY.md §7)
+                    res = self._batcher.submit(
+                        dindex,
+                        spec_base,
+                        window_cap=eng.window_cap,
+                        record_cap=eng.record_cap,
+                    )
+                else:
+                    res = run_queries(
+                        dindex,
+                        [spec_base],
+                        window_cap=eng.window_cap,
+                        record_cap=eng.record_cap,
+                    )
                 if res.overflow[0] or res.n_matched[0] > eng.record_cap:
                     rows = host_match_rows(shard, spec_base)
                 else:

@@ -1,0 +1,185 @@
+"""Request micro-batcher: concurrent queries share one kernel launch.
+
+SURVEY.md §7 names this load-bearing: single ad-hoc REST queries are the
+anti-pattern for a TPU (one query = one tiny vmap lane), so concurrent
+requests must accumulate into one batched kernel invocation. The
+reference faced the inverse economics — each query *fans out* to hundreds
+of bcftools lambdas (reference: splitQuery/lambda_function.py:45-69) —
+so this component has no reference counterpart; it is the TPU-native
+replacement for that entire fan-out layer at serving time.
+
+Leader-election design (no dedicated flusher thread, zero idle cost):
+the first request into an empty accumulator becomes the leader, waits up
+to ``max_wait_ms`` for followers (or until ``max_batch`` arrive), then
+executes the whole batch with one ``run_queries`` call and hands each
+waiter its row of the results. Batch shapes are padded to power-of-two
+buckets so XLA compiles one program per bucket instead of one per batch
+size.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from dataclasses import dataclass
+
+import numpy as np
+
+from .ops.kernel import QueryResults, encode_queries, run_queries
+from .utils.trace import span
+
+
+def bucket_size(n: int, max_batch: int) -> int:
+    """Smallest power-of-two >= n (floor 8, cap max_batch) — static shapes
+    per bucket keep XLA from recompiling on every distinct batch size."""
+    b = 8
+    while b < n:
+        b *= 2
+    return min(b, max(max_batch, 8))
+
+
+def _pad_encoded(enc: dict[str, np.ndarray], n_pad: int) -> dict:
+    """Pad a query batch by repeating row 0 (results are discarded)."""
+    n = enc["chrom"].shape[0]
+    if n == n_pad:
+        return enc
+    out = {}
+    for k, v in enc.items():
+        pad = np.repeat(v[:1], n_pad - n, axis=0)
+        out[k] = np.concatenate([v, pad], axis=0)
+    return out
+
+
+@dataclass
+class _Pending:
+    spec: object
+    event: threading.Event
+    result: object = None
+    error: BaseException | None = None
+
+
+class _Accumulator:
+    """Per-(device-index, caps) accumulation queue."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.items: list[_Pending] = []
+        self.leader_active = False
+
+
+class MicroBatcher:
+    """Batches ``run_queries`` calls per device index.
+
+    ``submit`` blocks until the caller's query has executed (alone after
+    ``max_wait_ms`` of quiet, or sooner as part of a fuller batch) and
+    returns that query's row of the :class:`QueryResults`.
+    """
+
+    def __init__(self, *, max_batch: int = 512, max_wait_ms: float = 2.0):
+        self.max_batch = max_batch
+        self.max_wait_s = max_wait_ms / 1e3
+        # weak-keyed by the DeviceIndex so accumulators die with their
+        # index (re-ingestion replaces DeviceIndex objects; an id()-keyed
+        # dict would leak one accumulator per replaced index and could
+        # alias a recycled id onto stale state)
+        self._accums: "weakref.WeakKeyDictionary[object, dict]" = (
+            weakref.WeakKeyDictionary()
+        )
+        self._lock = threading.Lock()
+
+    def _accum(self, dindex, caps: tuple) -> _Accumulator:
+        with self._lock:
+            by_caps = self._accums.get(dindex)
+            if by_caps is None:
+                by_caps = {}
+                self._accums[dindex] = by_caps
+            acc = by_caps.get(caps)
+            if acc is None:
+                acc = by_caps[caps] = _Accumulator()
+            return acc
+
+    def submit(
+        self,
+        dindex,
+        spec,
+        *,
+        window_cap: int,
+        record_cap: int,
+    ):
+        """Returns (exists, call_count, n_variants, all_alleles_count,
+        n_matched, overflow, rows) for this one query — one row of the
+        batched QueryResults."""
+        acc = self._accum(dindex, (window_cap, record_cap))
+        me = _Pending(spec=spec, event=threading.Event())
+
+        with acc.lock:
+            acc.items.append(me)
+            if acc.leader_active:
+                lead = False
+            else:
+                acc.leader_active = True
+                lead = True
+
+        if lead:
+            self._lead(acc, dindex, window_cap, record_cap)
+        else:
+            me.event.wait()
+        if me.error is not None:
+            raise me.error
+        return me.result
+
+    def _lead(self, acc: _Accumulator, dindex, window_cap, record_cap):
+        # wait for followers: either the batch fills or the window lapses
+        sleeper = threading.Event()  # timed wait without busy-looping
+        waited = 0.0
+        step = self.max_wait_s / 4 if self.max_wait_s > 0 else 0
+        while waited < self.max_wait_s:
+            with acc.lock:
+                if len(acc.items) >= self.max_batch:
+                    break
+            sleeper.wait(step)
+            waited += step
+
+        while True:
+            with acc.lock:
+                batch = acc.items[: self.max_batch]
+                acc.items = acc.items[self.max_batch :]
+                more = bool(acc.items)
+                if not more:
+                    acc.leader_active = False
+            if not batch:
+                return
+            self._execute(batch, dindex, window_cap, record_cap)
+            if not more:
+                return
+
+    def _execute(self, batch, dindex, window_cap, record_cap):
+        specs = [p.spec for p in batch]
+        try:
+            with span("serving.microbatch") as sp:
+                enc = encode_queries(specs)
+                n_pad = bucket_size(len(specs), self.max_batch)
+                enc = _pad_encoded(enc, n_pad)
+                res = run_queries(
+                    dindex,
+                    enc,
+                    window_cap=window_cap,
+                    record_cap=record_cap,
+                )
+                sp.note(batch=len(specs), padded=n_pad)
+        except BaseException as e:
+            for p in batch:
+                p.error = e
+                p.event.set()
+            return
+        for i, p in enumerate(batch):
+            p.result = QueryResults(
+                exists=res.exists[i : i + 1],
+                call_count=res.call_count[i : i + 1],
+                n_variants=res.n_variants[i : i + 1],
+                all_alleles_count=res.all_alleles_count[i : i + 1],
+                n_matched=res.n_matched[i : i + 1],
+                overflow=res.overflow[i : i + 1],
+                rows=res.rows[i : i + 1],
+            )
+            p.event.set()

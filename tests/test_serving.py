@@ -1,0 +1,152 @@
+"""Serving micro-batcher: correctness under concurrency, bucketing,
+error propagation, and end-to-end equivalence with unbatched execution."""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.ops.kernel import DeviceIndex, QuerySpec, run_queries
+from sbeacon_tpu.serving import MicroBatcher, bucket_size
+from sbeacon_tpu.testing import random_records
+
+
+@pytest.fixture(scope="module")
+def dindex():
+    rng = random.Random(7)
+    recs = random_records(rng, chrom="1", n=300, n_samples=2)
+    shard = build_index(
+        recs, dataset_id="ds", vcf_location="v", sample_names=["S0", "S1"]
+    )
+    return shard, DeviceIndex(shard, pad_unit=1024)
+
+
+def specs_for(shard, n):
+    rng = random.Random(n)
+    pos = shard.cols["pos"]
+    out = []
+    for i in range(n):
+        p = int(pos[rng.randrange(len(pos))])
+        out.append(
+            QuerySpec("1", max(1, p - 5), p + 5, 1, 1 << 30, alternate_bases="N")
+        )
+    return out
+
+
+def test_bucket_size():
+    assert bucket_size(1, 512) == 8
+    assert bucket_size(8, 512) == 8
+    assert bucket_size(9, 512) == 16
+    assert bucket_size(300, 512) == 512
+    assert bucket_size(3, 4) == 8  # floor keeps a sane minimum
+
+
+def test_single_submit_matches_direct(dindex):
+    shard, di = dindex
+    (spec,) = specs_for(shard, 1)
+    mb = MicroBatcher(max_batch=64, max_wait_ms=0)
+    got = mb.submit(di, spec, window_cap=256, record_cap=64)
+    ref = run_queries(di, [spec], window_cap=256, record_cap=64)
+    assert got.exists[0] == ref.exists[0]
+    assert got.call_count[0] == ref.call_count[0]
+    assert got.all_alleles_count[0] == ref.all_alleles_count[0]
+    np.testing.assert_array_equal(got.rows[0], ref.rows[0])
+
+
+def test_concurrent_submits_match_direct_and_batch(dindex):
+    shard, di = dindex
+    n = 32
+    specs = specs_for(shard, n)
+    ref = run_queries(di, specs, window_cap=256, record_cap=64)
+    mb = MicroBatcher(max_batch=64, max_wait_ms=20)
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def go(i):
+        barrier.wait()
+        results[i] = mb.submit(di, specs[i], window_cap=256, record_cap=64)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(n):
+        assert results[i].exists[0] == ref.exists[i], i
+        assert results[i].call_count[0] == ref.call_count[i], i
+        np.testing.assert_array_equal(results[i].rows[0], ref.rows[i])
+
+
+def test_max_batch_overflow_drains(dindex):
+    """More waiters than max_batch: the leader drains in several rounds."""
+    shard, di = dindex
+    n = 20
+    specs = specs_for(shard, n)
+    ref = run_queries(di, specs, window_cap=256, record_cap=64)
+    mb = MicroBatcher(max_batch=8, max_wait_ms=10)
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def go(i):
+        barrier.wait()
+        results[i] = mb.submit(di, specs[i], window_cap=256, record_cap=64)
+
+    threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    for i in range(n):
+        assert results[i].exists[0] == ref.exists[i], i
+
+
+def test_error_propagates_to_all_waiters(dindex):
+    shard, di = dindex
+    mb = MicroBatcher(max_batch=8, max_wait_ms=0)
+
+    class BadIndex:
+        """Object lacking .arrays — run_queries raises for every batch."""
+
+        n_iters = 4
+
+    (spec,) = specs_for(shard, 1)
+    with pytest.raises(Exception):
+        mb.submit(BadIndex(), spec, window_cap=256, record_cap=64)
+    # the accumulator must be reusable after a failed round
+    with pytest.raises(Exception):
+        mb.submit(BadIndex(), spec, window_cap=256, record_cap=64)
+
+
+def test_engine_batched_equals_unbatched():
+    """End-to-end: identical search responses with microbatch on/off."""
+    import dataclasses
+
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.payloads import VariantQueryPayload
+
+    rng = random.Random(3)
+    recs = random_records(rng, chrom="1", n=200, n_samples=2)
+    shard = build_index(
+        recs, dataset_id="ds", vcf_location="v", sample_names=["S0", "S1"]
+    )
+    pay = VariantQueryPayload(
+        dataset_ids=["ds"],
+        reference_name="1",
+        start_min=1,
+        start_max=1 << 30,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        include_datasets="HIT",
+    )
+    on = VariantEngine(BeaconConfig(engine=EngineConfig(microbatch=True)))
+    off = VariantEngine(BeaconConfig(engine=EngineConfig(microbatch=False)))
+    on.add_index(shard)
+    off.add_index(shard)
+    r_on = on.search(pay)
+    r_off = off.search(pay)
+    assert len(r_on) == len(r_off) == 1
+    assert r_on[0].dumps() == r_off[0].dumps()
