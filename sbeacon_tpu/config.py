@@ -131,6 +131,9 @@ class IngestConfig:
     max_concurrency: int = 64  # MAX_CONCURRENCY
     workers: int = 8  # parallel slice workers
     max_range_bytes: int = 750 * 1024 * 1024  # ABS_MAX_DATA_SPLIT
+    # also materialise reference-layout binary region files per VCF
+    # (vcf-summaries/ portable exchange format, index/portable.py)
+    export_portable: bool = True
 
 
 @dataclasses.dataclass(frozen=True)

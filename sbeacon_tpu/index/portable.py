@@ -1,0 +1,272 @@
+"""Portable binary index files: the reference's on-S3 variant-index format.
+
+The reference's ingest pipeline materialises, per VCF, a set of compact
+binary region files under
+``vcf-summaries/contig/{contig}/{escaped-location}/regions/{start}-{end}-{size}``
+(reference: write_data_to_s3.h:98 key layout, parsed back at
+initDuplicateVariantSearch.py:80-90), each a gzip stream of
+``pos:u64 | len:u16 | packed_ref '_' packed_alt`` records with 4-bit base
+packing, split at >100 kb position gaps (MAX_SLICE_GAP, main.tf:215) and
+a 50 MB size ceiling (VCF_S3_OUTPUT_SIZE_LIMIT, main.tf:17). The
+duplicate-variant search then reads ranges of these files and dedupes on
+the ``{pos}{payload}`` key (duplicateVariantSearch.cpp:56-59).
+
+Here the columnar shard (``columnar.py``) is the primary store; this
+module provides the same portable exchange format — export from a shard,
+range-filtered import, cross-dataset distinct-count — with the hot
+encode/decode in C++ (``native/src/index_codec.cpp``) and a pure-Python
+mirror used as fallback and as the round-trip oracle in tests.
+"""
+
+from __future__ import annotations
+
+import struct
+import zlib
+from pathlib import Path
+
+import numpy as np
+
+from .. import native
+from ..utils.chrom import CHROMOSOME_CODES
+from .columnar import VariantIndexShard
+
+#: reference terraform ceilings (main.tf:16-17,215)
+MAX_SLICE_GAP = 100_000
+MAX_FILE_RAW_BYTES = 50 * 1024 * 1024
+
+_BASE_CODE = {
+    65: 1, 97: 1,  # A a
+    67: 2, 99: 2,  # C c
+    71: 3, 103: 3,  # G g
+    84: 4, 116: 4,  # T t
+    78: 5, 110: 5,  # N n
+    42: 6,  # *
+    46: 7,  # .
+}
+_CODE_BASE = b"?ACGTN*."
+
+
+def pack_seq(seq: bytes) -> bytes:
+    """4-bit pack (first base of a pair in the high nibble, odd trailing
+    base low-nibble alone); symbolic ``<...>`` and any unpackable text
+    pass through raw (brackets stripped) — write_data_to_s3.h compressSeq."""
+    n = len(seq)
+    if n >= 2 and seq[0] == 0x3C and seq[-1] == 0x3E:  # <...>
+        return seq[1:-1]
+    codes = []
+    for b in seq:
+        c = _BASE_CODE.get(b)
+        if c is None:
+            return seq
+        codes.append(c)
+    if n == 1:
+        return bytes(codes)
+    out = bytearray()
+    for i in range(0, n - 1, 2):
+        out.append((codes[i] << 4) | codes[i + 1])
+    if n % 2:
+        out.append(codes[-1])
+    return bytes(out)
+
+
+def unpack_seq(packed: bytes) -> bytes | None:
+    """Inverse of :func:`pack_seq` for packed payloads; None when the
+    bytes cannot be a packed sequence.
+
+    HEURISTIC, exactly as ambiguous as the reference format itself: a
+    raw/symbolic payload whose every byte happens to parse as valid
+    nibble pairs (e.g. ``<GATA>`` stored raw as ``GATA``) decodes to a
+    fabricated sequence. The format has no raw marker (the reference
+    never decodes — it only compares packed payloads as opaque dedupe
+    keys, duplicateVariantSearch.cpp:56-59); treat decoded text as
+    display-only and use the payload bytes for identity."""
+    out = bytearray()
+    n = len(packed)
+    for i, b in enumerate(packed):
+        hi, lo = b >> 4, b & 0xF
+        if lo == 0 or lo > 7 or hi > 7:
+            return None
+        if hi == 0:
+            if i + 1 != n:
+                return None
+            out.append(_CODE_BASE[lo])
+        else:
+            out.append(_CODE_BASE[hi])
+            out.append(_CODE_BASE[lo])
+    return bytes(out)
+
+
+def pack_records_py(pos, refs, alts, *, level: int = 9) -> bytes:
+    """Pure-Python encoder (same wire format as the native codec)."""
+    if not (len(pos) == len(refs) == len(alts)):
+        raise ValueError("pos/refs/alts length mismatch")
+    parts = []
+    for p, ref, alt in zip(pos, refs, alts):
+        payload = pack_seq(ref) + b"_" + pack_seq(alt)
+        if len(payload) > 0xFFFF:
+            raise ValueError("allele too long for u16 record length")
+        parts.append(struct.pack("<QH", int(p), len(payload)) + payload)
+    co = zlib.compressobj(level, zlib.DEFLATED, 15 + 16)
+    return co.compress(b"".join(parts)) + co.flush()
+
+
+def unpack_records_py(
+    blob: bytes, range_start: int = 0, range_end: int = 2**63 - 1
+):
+    """Pure-Python decoder: (pos uint64 ndarray, payload list[bytes])."""
+    raw = zlib.decompress(blob, 15 + 32)
+    positions, payloads = [], []
+    i, n = 0, len(raw)
+    while i + 10 <= n:
+        p, ln = struct.unpack_from("<QH", raw, i)
+        i += 10
+        if i + ln > n:
+            raise ValueError("truncated record")
+        if range_start <= p <= range_end:
+            positions.append(p)
+            payloads.append(raw[i : i + ln])
+        i += ln
+    if i != n:
+        raise ValueError("truncated record")
+    return np.asarray(positions, dtype=np.uint64), payloads
+
+
+def pack_records(pos, refs, alts, *, level: int = 9) -> bytes:
+    if native.available():
+        return native.pack_records(pos, list(refs), list(alts), level=level)
+    return pack_records_py(pos, refs, alts, level=level)
+
+
+def unpack_records(
+    blob: bytes, range_start: int = 0, range_end: int = 2**63 - 1
+):
+    if native.available():
+        return native.unpack_records(blob, range_start, range_end)
+    return unpack_records_py(blob, range_start, range_end)
+
+
+# -- region-file export / import ---------------------------------------------
+
+
+def _escape_location(location: str) -> str:
+    """Reference key escaping: '/' -> '%' (write_data_to_s3.h ctor)."""
+    return str(location).replace("/", "%")
+
+
+def export_region_files(
+    shard: VariantIndexShard,
+    out_dir: str | Path,
+    *,
+    max_gap: int = MAX_SLICE_GAP,
+    max_raw_bytes: int = MAX_FILE_RAW_BYTES,
+    level: int = 9,
+) -> list[Path]:
+    """Write the shard as reference-layout region files:
+    ``contig/{chrom}/{escaped-location}/regions/{start}-{end}-{rawsize}``,
+    new file at every >max_gap position gap or raw-size ceiling."""
+    out_dir = Path(out_dir)
+    location = _escape_location(shard.meta.get("vcf_location", "unknown"))
+    pos = shard.cols["pos"]
+    ref_off = shard.ref_off
+    alt_off = shard.alt_off
+    ref_blob = shard.ref_blob.tobytes()
+    alt_blob = shard.alt_blob.tobytes()
+    written: list[Path] = []
+
+    # re-ingest must not leave stale region files from a previous export
+    # of this VCF (the export is a full rewrite, like the npz shard)
+    import shutil
+
+    for old in out_dir.glob(f"contig/*/{location}"):
+        shutil.rmtree(old, ignore_errors=True)
+
+    def row_ref_b(i: int) -> bytes:
+        return ref_blob[ref_off[i] : ref_off[i + 1]]
+
+    def row_alt_b(i: int) -> bytes:
+        return alt_blob[alt_off[i] : alt_off[i + 1]]
+
+    for chrom, code in CHROMOSOME_CODES.items():
+        lo = int(shard.chrom_offsets[code])
+        hi = int(shard.chrom_offsets[code + 1])
+        if hi <= lo:
+            continue
+        rdir = out_dir / "contig" / chrom / location / "regions"
+        rdir.mkdir(parents=True, exist_ok=True)
+        # raw record size = 10-byte header + packed ref + '_' + packed alt
+        # (the reference's {size} suffix counts the pre-gzip packed stream,
+        # write_data_to_s3.h bufferLength)
+        rec_raw = np.asarray(
+            [
+                10 + len(pack_seq(row_ref_b(i))) + 1 + len(pack_seq(row_alt_b(i)))
+                for i in range(lo, hi)
+            ],
+            dtype=np.int64,
+        )
+        start = lo
+        raw_bytes = 0
+
+        def flush(start_row: int, end_row: int, raw: int):
+            """[start_row, end_row) -> one region file."""
+            blob = pack_records(
+                pos[start_row:end_row].astype(np.uint64),
+                [row_ref_b(i) for i in range(start_row, end_row)],
+                [row_alt_b(i) for i in range(start_row, end_row)],
+                level=level,
+            )
+            name = f"{int(pos[start_row])}-{int(pos[end_row - 1])}-{raw}"
+            path = rdir / name
+            path.write_bytes(blob)
+            written.append(path)
+
+        for i in range(lo, hi):
+            gap_split = i > start and int(pos[i]) > int(pos[i - 1]) + max_gap
+            size_split = (
+                raw_bytes + int(rec_raw[i - lo]) > max_raw_bytes and i > start
+            )
+            if gap_split or size_split:
+                flush(start, i, raw_bytes)
+                start, raw_bytes = i, 0
+            raw_bytes += int(rec_raw[i - lo])
+        flush(start, hi, raw_bytes)
+    return written
+
+
+def parse_region_filename(path: str | Path) -> tuple[int, int, int]:
+    """(start, end, raw_size) from '{start}-{end}-{size}' — the parse at
+    initDuplicateVariantSearch.py:80-90."""
+    start, end, size = Path(path).name.rsplit("-", 2)
+    return int(start), int(end), int(size)
+
+
+def iter_region_files(root: str | Path):
+    """Yield (chrom, location, path, start, end, raw_size) under an export
+    root."""
+    root = Path(root)
+    for path in sorted(root.glob("contig/*/*/regions/*")):
+        chrom = path.parts[-4]
+        location = path.parts[-3]
+        start, end, size = parse_region_filename(path)
+        yield chrom, location, path, start, end, size
+
+
+def distinct_variant_count_files(
+    roots: list[str | Path],
+    *,
+    range_start: int = 0,
+    range_end: int = 2**63 - 1,
+) -> int:
+    """Distinct (contig, pos, payload) across exported datasets — the
+    duplicateVariantSearch tally (duplicateVariantSearch.cpp:31-84) over
+    the portable files instead of live shards."""
+    seen: set[tuple[str, int, bytes]] = set()
+    for root in roots:
+        for chrom, _loc, path, start, end, _size in iter_region_files(root):
+            if end < range_start or start > range_end:
+                continue
+            positions, payloads = unpack_records(
+                path.read_bytes(), range_start, range_end
+            )
+            for p, pay in zip(positions.tolist(), payloads):
+                seen.add((chrom, int(p), bytes(pay)))
+    return len(seen)

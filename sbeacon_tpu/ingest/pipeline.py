@@ -200,6 +200,14 @@ class SummarisationPipeline:
         merged.meta["dataset_id"] = dataset_id
         merged.meta["vcf_location"] = str(vcf)
         save_index(merged, final)
+        if self.config.ingest.export_portable:
+            # reference-layout binary region files (vcf-summaries/ role,
+            # write_data_to_s3.h) alongside the primary npz shard
+            from ..index.portable import export_region_files
+
+            export_region_files(
+                merged, self.config.storage.index_dir / "portable" / dataset_id
+            )
         for p in slice_dir.glob("*"):
             p.unlink()
         slice_dir.rmdir()

@@ -24,7 +24,7 @@ log = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "src"
 _LIB_PATH = Path(__file__).parent / "_sbnative.so"
-_SOURCES = ["bgzf.cpp", "scan.cpp"]
+_SOURCES = ["bgzf.cpp", "scan.cpp", "index_codec.cpp"]
 
 _lock = threading.Lock()
 _lib = None
@@ -111,6 +111,35 @@ def get_lib():
             ctypes.c_uint64,
         ]
         lib.sbn_line_offsets.restype = ctypes.c_int64
+        lib.sbn_pack_records.argtypes = [
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint32),
+            ctypes.c_int,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sbn_pack_records.restype = ctypes.c_int
+        lib.sbn_unpack_records.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32)),
+        ]
+        lib.sbn_unpack_records.restype = ctypes.c_int64
+        lib.sbn_unpack_seq.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+        ]
+        lib.sbn_unpack_seq.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -184,6 +213,127 @@ def compress_bgzf(data: bytes, level: int = 6) -> bytes:
     if rc != 0:
         raise NativeUnavailable(f"sbn_compress_bgzf failed rc={rc}")
     return _take_buffer(lib, out_p, out_len)
+
+
+def pack_records(
+    pos, refs: list[bytes], alts: list[bytes], *, level: int = 9
+) -> bytes:
+    """Gzip blob of (pos, packed ref'_'alt) records — the reference
+    writeDataToS3 on-S3 index format (write_data_to_s3.h:30-228)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    n = len(refs)
+    pos_a = np.ascontiguousarray(pos, dtype=np.uint64)
+    if pos_a.shape != (n,) or len(alts) != n:
+        raise ValueError("pos/refs/alts length mismatch")
+
+    def runs(items):
+        offs = np.zeros(n + 1, dtype=np.uint32)
+        offs[1:] = np.cumsum([len(b) for b in items], dtype=np.uint64)
+        return b"".join(items), offs
+
+    ref_bytes, ref_offs = runs(refs)
+    alt_bytes, alt_offs = runs(alts)
+    out_p = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+
+    def u8(b):
+        return (
+            (ctypes.c_uint8 * len(b)).from_buffer_copy(b)
+            if b
+            else (ctypes.c_uint8 * 1)()
+        )
+
+    rc = lib.sbn_pack_records(
+        n,
+        pos_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        u8(ref_bytes),
+        ref_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        u8(alt_bytes),
+        alt_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        level,
+        ctypes.byref(out_p),
+        ctypes.byref(out_len),
+    )
+    if rc == 3:
+        # data error, not an environment error — match the pure-Python
+        # encoder's exception for the same input
+        raise ValueError("allele too long for u16 record length")
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_pack_records failed rc={rc}")
+    return _take_buffer(lib, out_p, out_len)
+
+
+def unpack_records(
+    blob: bytes,
+    range_start: int = 0,
+    range_end: int = 2**63 - 1,
+):
+    """(pos: uint64 ndarray, payloads: list[bytes]) for records in
+    [range_start, range_end] — the ReadVcfData range-filtered read
+    (readVcfData.cpp:3-38). Payloads are the packed ref'_'alt keys the
+    reference dedupes on."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    out_pos = ctypes.POINTER(ctypes.c_uint64)()
+    out_payload = ctypes.POINTER(ctypes.c_uint8)()
+    out_offs = ctypes.POINTER(ctypes.c_uint32)()
+    buf = (
+        (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        if blob
+        else (ctypes.c_uint8 * 1)()
+    )
+    n = lib.sbn_unpack_records(
+        buf,
+        len(blob),
+        range_start,
+        range_end,
+        ctypes.byref(out_pos),
+        ctypes.byref(out_payload),
+        ctypes.byref(out_offs),
+    )
+    if n < 0:
+        raise NativeUnavailable(f"sbn_unpack_records failed rc={n}")
+    try:
+        pos = np.ctypeslib.as_array(out_pos, shape=(n,)).copy()
+        offs = np.ctypeslib.as_array(out_offs, shape=(n + 1,)).copy()
+        payload = (
+            ctypes.string_at(out_payload, int(offs[-1])) if n else b""
+        )
+    finally:
+        lib.sbn_free(ctypes.cast(out_pos, ctypes.POINTER(ctypes.c_uint8)))
+        lib.sbn_free(out_payload)
+        lib.sbn_free(ctypes.cast(out_offs, ctypes.POINTER(ctypes.c_uint8)))
+    return pos, [
+        payload[offs[i] : offs[i + 1]] for i in range(n)
+    ]
+
+
+def unpack_seq(packed: bytes) -> bytes | None:
+    """Sequence text for a packed payload half; None when it was stored
+    raw (symbolic allele passthrough)."""
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    cap = max(2 * len(packed), 1)
+    out = (ctypes.c_uint8 * cap)()
+    buf = (
+        (ctypes.c_uint8 * len(packed)).from_buffer_copy(packed)
+        if packed
+        else (ctypes.c_uint8 * 1)()
+    )
+    n = lib.sbn_unpack_seq(buf, len(packed), out, cap)
+    if n == -1:
+        return None
+    if n < 0:
+        raise NativeUnavailable(f"sbn_unpack_seq failed rc={n}")
+    return bytes(out[:n])
 
 
 def count_slice(text: bytes) -> tuple[int, int, int]:

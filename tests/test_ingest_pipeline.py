@@ -134,6 +134,18 @@ def test_sliced_summarisation_parity(corpus):
     assert summary["call_count"] == want.meta["call_count"]
     assert summary["sample_count"] == 3
 
+    # ingest also materialised the reference-layout portable region files
+    # (vcf-summaries/ role) and they round-trip to the same row count
+    from sbeacon_tpu.index import portable as pt
+
+    proot = pipe.config.storage.index_dir / "portable" / "ds"
+    files = list(pt.iter_region_files(proot))
+    assert {f[0] for f in files} == {"1", "2"}
+    total = sum(
+        len(pt.unpack_records(f[2].read_bytes())[1]) for f in files
+    )
+    assert total == want.n_rows
+
 
 def test_dataset_stage_distinct_count(corpus, tmp_path):
     tmp_path_, vcf, recs = corpus

@@ -1,0 +1,223 @@
+"""Portable binary index format: native <-> Python cross-parity,
+round-trips, region-file export/import, distinct-count.
+
+The wire format is the reference's on-S3 index layout
+(write_data_to_s3.h / readVcfData.cpp): gzip of
+``pos:u64 | len:u16 | packed_ref '_' packed_alt`` with 4-bit base codes.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu import native
+from sbeacon_tpu.index import portable as pt
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.testing import random_records
+
+
+def test_pack_seq_semantics():
+    # single base -> one low-nibble byte
+    assert pt.pack_seq(b"A") == bytes([1])
+    # pair -> first base high nibble
+    assert pt.pack_seq(b"AC") == bytes([(1 << 4) | 2])
+    # odd tail -> low-nibble byte of its own
+    assert pt.pack_seq(b"ACG") == bytes([(1 << 4) | 2, 3])
+    # case-insensitive
+    assert pt.pack_seq(b"acgt") == pt.pack_seq(b"ACGT")
+    # symbolic: contents without brackets, raw
+    assert pt.pack_seq(b"<DEL>") == b"DEL"
+    # unpackable text passes through raw
+    assert pt.pack_seq(b"AXG") == b"AXG"
+
+
+def test_unpack_seq_roundtrip():
+    for seq in (b"A", b"AC", b"ACG", b"ACGTN", b"T" * 31, b"*", b"."):
+        assert pt.unpack_seq(pt.pack_seq(seq)) == seq
+    # raw/symbolic payloads are flagged None
+    assert pt.unpack_seq(pt.pack_seq(b"<DUP:TANDEM>")) is None
+
+
+def _sample_alleles(rng, n):
+    bases = "ACGTN"
+    pos, refs, alts = [], [], []
+    p = 100
+    for _ in range(n):
+        p += rng.randrange(1, 2000)
+        pos.append(p)
+        refs.append(
+            "".join(rng.choice(bases) for _ in range(rng.randrange(1, 9))).encode()
+        )
+        alts.append(
+            rng.choice(
+                [
+                    "".join(
+                        rng.choice(bases) for _ in range(rng.randrange(1, 7))
+                    ).encode(),
+                    b"<DEL>",
+                    b"<DUP:TANDEM>",
+                    b"*",
+                ]
+            )
+        )
+    return pos, refs, alts
+
+
+def test_records_roundtrip_python():
+    rng = random.Random(1)
+    pos, refs, alts = _sample_alleles(rng, 500)
+    blob = pt.pack_records_py(pos, refs, alts)
+    got_pos, payloads = pt.unpack_records_py(blob)
+    np.testing.assert_array_equal(got_pos, np.asarray(pos, dtype=np.uint64))
+    for ref, alt, pay in zip(refs, alts, payloads):
+        assert pay == pt.pack_seq(ref) + b"_" + pt.pack_seq(alt)
+
+
+def test_records_range_filter():
+    pos = [100, 200, 300, 400]
+    refs = [b"A"] * 4
+    alts = [b"T"] * 4
+    blob = pt.pack_records_py(pos, refs, alts)
+    got_pos, payloads = pt.unpack_records_py(blob, 150, 350)
+    assert got_pos.tolist() == [200, 300]
+    assert len(payloads) == 2
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_native_python_cross_parity():
+    rng = random.Random(2)
+    pos, refs, alts = _sample_alleles(rng, 800)
+    blob_native = native.pack_records(pos, refs, alts)
+    blob_py = pt.pack_records_py(pos, refs, alts)
+    # both decoders accept both encoders' output with identical results
+    for blob in (blob_native, blob_py):
+        for decode in (native.unpack_records, pt.unpack_records_py):
+            got_pos, payloads = decode(blob, 0, 2**63 - 1)
+            np.testing.assert_array_equal(
+                np.asarray(got_pos, dtype=np.uint64),
+                np.asarray(pos, dtype=np.uint64),
+            )
+            assert payloads == [
+                pt.pack_seq(r) + b"_" + pt.pack_seq(a)
+                for r, a in zip(refs, alts)
+            ]
+    # range filter agrees too
+    mid = pos[len(pos) // 2]
+    p1, _ = native.unpack_records(blob_py, mid, 2**63 - 1)
+    p2, _ = pt.unpack_records_py(blob_native, mid, 2**63 - 1)
+    np.testing.assert_array_equal(np.asarray(p1), np.asarray(p2))
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_native_unpack_seq():
+    for seq in (b"A", b"ACGT", b"ACGTN"):
+        assert native.unpack_seq(pt.pack_seq(seq)) == seq
+    assert native.unpack_seq(b"DEL") is None
+
+
+def _shard(seed=3, n=300, chrom="1"):
+    rng = random.Random(seed)
+    recs = random_records(rng, chrom=chrom, n=n, n_samples=2)
+    return build_index(
+        recs,
+        dataset_id=f"ds{seed}",
+        vcf_location=f"bucket/path/ds{seed}.vcf.gz",
+        sample_names=["S0", "S1"],
+    )
+
+
+def test_export_region_files_layout_and_roundtrip(tmp_path):
+    shard = _shard()
+    files = pt.export_region_files(shard, tmp_path)
+    assert files
+    # reference key layout: contig/{chrom}/{escaped}/regions/{s}-{e}-{size}
+    rel = files[0].relative_to(tmp_path)
+    assert rel.parts[0] == "contig"
+    assert rel.parts[1] == "1"
+    assert "%" in rel.parts[2] and "/" not in rel.parts[2]
+    assert rel.parts[3] == "regions"
+    start, end, size = pt.parse_region_filename(files[0])
+    assert start <= end and size > 0
+    # every row round-trips
+    total = 0
+    pos_all = []
+    for chrom, _loc, path, s, e, _sz in pt.iter_region_files(tmp_path):
+        got_pos, payloads = pt.unpack_records(path.read_bytes())
+        assert got_pos.min() >= s and got_pos.max() <= e
+        total += len(payloads)
+        pos_all.extend(got_pos.tolist())
+    assert total == shard.n_rows
+    np.testing.assert_array_equal(
+        np.sort(np.asarray(pos_all)), np.sort(shard.cols["pos"])
+    )
+
+
+def test_export_splits_on_gap(tmp_path):
+    from sbeacon_tpu.genomics.vcf import VcfRecord
+
+    # two clusters separated by >MAX_SLICE_GAP -> two region files
+    recs = [
+        VcfRecord(
+            chrom="1",
+            pos=p,
+            ref="A",
+            alts=["T"],
+            ac=[1],
+            an=2,
+            vt="SNP",
+            genotypes=[],
+        )
+        for p in [1000, 1100, 1200, 500_000, 500_100]
+    ]
+    shard = build_index(recs, dataset_id="d", vcf_location="x.vcf.gz")
+    files = pt.export_region_files(shard, tmp_path)
+    assert len(files) == 2
+    spans = sorted(pt.parse_region_filename(f)[:2] for f in files)
+    assert spans == [(1000, 1200), (500_000, 500_100)]
+
+
+def test_reexport_clears_stale_files(tmp_path):
+    """Re-ingesting a changed VCF must not leave stale region files that
+    would double-count on import."""
+    big = _shard(seed=20, n=300)
+    pt.export_region_files(big, tmp_path)
+    n_before = len(list(pt.iter_region_files(tmp_path)))
+    # same vcf_location, fewer rows (simulates a changed source VCF)
+    small = _shard(seed=21, n=50)
+    small.meta["vcf_location"] = big.meta["vcf_location"]
+    pt.export_region_files(small, tmp_path)
+    total = sum(
+        len(pt.unpack_records(f[2].read_bytes())[1])
+        for f in pt.iter_region_files(tmp_path)
+    )
+    assert total == small.n_rows
+    assert n_before >= 1
+
+
+def test_length_mismatch_raises_both_paths():
+    with pytest.raises(ValueError):
+        pt.pack_records_py([1, 2], [b"A"], [b"T", b"G"])
+    if native.available():
+        with pytest.raises(ValueError):
+            native.pack_records([1, 2], [b"A"], [b"T", b"G"])
+
+
+def test_distinct_count_files_matches_shard_dedupe(tmp_path):
+    s1 = _shard(seed=10, n=200)
+    s2 = _shard(seed=10, n=200)  # identical -> fully duplicated
+    s3 = _shard(seed=11, n=150)
+    roots = []
+    for i, s in enumerate((s1, s2, s3)):
+        root = tmp_path / f"ds{i}"
+        pt.export_region_files(s, root)
+        roots.append(root)
+    got = pt.distinct_variant_count_files(roots)
+    expected = len(
+        {
+            ("1", int(s.cols["pos"][i]), s.row_ref(i), s.row_alt(i))
+            for s in (s1, s2, s3)
+            for i in range(s.n_rows)
+        }
+    )
+    assert got == expected
