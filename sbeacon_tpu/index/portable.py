@@ -69,6 +69,17 @@ def pack_seq(seq: bytes) -> bytes:
     return bytes(out)
 
 
+def packed_len(seq: bytes) -> int:
+    """len(pack_seq(seq)) computed arithmetically, without building the
+    packed bytes (used by the export sizing pass)."""
+    n = len(seq)
+    if n >= 2 and seq[0] == 0x3C and seq[-1] == 0x3E:
+        return n - 2
+    if not all(b in _BASE_CODE for b in seq):
+        return n  # raw passthrough
+    return 1 if n == 1 else n // 2 + n % 2
+
+
 def unpack_seq(packed: bytes) -> bytes | None:
     """Inverse of :func:`pack_seq` for packed payloads; None when the
     bytes cannot be a packed sequence.
@@ -174,10 +185,12 @@ def export_region_files(
     written: list[Path] = []
 
     # re-ingest must not leave stale region files from a previous export
-    # of this VCF (the export is a full rewrite, like the npz shard)
+    # of this VCF (the export is a full rewrite, like the npz shard);
+    # glob-escape the location so [ ] * ? in file names match literally
+    import glob as _glob
     import shutil
 
-    for old in out_dir.glob(f"contig/*/{location}"):
+    for old in out_dir.glob(f"contig/*/{_glob.escape(location)}"):
         shutil.rmtree(old, ignore_errors=True)
 
     def row_ref_b(i: int) -> bytes:
@@ -198,7 +211,7 @@ def export_region_files(
         # write_data_to_s3.h bufferLength)
         rec_raw = np.asarray(
             [
-                10 + len(pack_seq(row_ref_b(i))) + 1 + len(pack_seq(row_alt_b(i)))
+                10 + packed_len(row_ref_b(i)) + 1 + packed_len(row_alt_b(i))
                 for i in range(lo, hi)
             ],
             dtype=np.int64,

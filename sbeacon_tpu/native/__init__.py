@@ -231,8 +231,11 @@ def pack_records(
         raise ValueError("pos/refs/alts length mismatch")
 
     def runs(items):
+        cum = np.cumsum([len(b) for b in items], dtype=np.uint64)
+        if len(cum) and cum[-1] >= 2**32:
+            raise ValueError("total allele bytes exceed u32 offset space")
         offs = np.zeros(n + 1, dtype=np.uint32)
-        offs[1:] = np.cumsum([len(b) for b in items], dtype=np.uint64)
+        offs[1:] = cum
         return b"".join(items), offs
 
     ref_bytes, ref_offs = runs(refs)

@@ -32,6 +32,14 @@ def test_pack_seq_semantics():
     assert pt.pack_seq(b"AXG") == b"AXG"
 
 
+def test_packed_len_matches_pack_seq():
+    for seq in (
+        b"A", b"AC", b"ACG", b"ACGTN", b"T" * 31, b"*", b".",
+        b"<DEL>", b"<DUP:TANDEM>", b"AXG", b"",
+    ):
+        assert pt.packed_len(seq) == len(pt.pack_seq(seq)), seq
+
+
 def test_unpack_seq_roundtrip():
     for seq in (b"A", b"AC", b"ACG", b"ACGTN", b"T" * 31, b"*", b"."):
         assert pt.unpack_seq(pt.pack_seq(seq)) == seq

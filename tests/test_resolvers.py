@@ -1,0 +1,176 @@
+"""Ontology resolver clients + term-tree indexer driver, over a fake
+transport (zero-egress parity with reference indexer:40-222 semantics)."""
+
+from sbeacon_tpu.metadata.ontology import OntologyStore
+from sbeacon_tpu.metadata.resolvers import (
+    OlsResolver,
+    OntoserverResolver,
+    TermTreeIndexer,
+    term_prefix,
+)
+from sbeacon_tpu.metadata.store import MetadataStore
+
+
+def test_term_prefix_snomed_sniff():
+    assert term_prefix("SNOMED:123") == "SNOMED"
+    assert term_prefix("snomed:123") == "SNOMED"
+    assert term_prefix("123456") == "SNOMED"  # bare numeric SNOMED code
+    assert term_prefix("HP:0000001") == "HP"
+    assert term_prefix("ncit:C20197") == "NCIT"
+
+
+class FakeOls:
+    """Transport mimicking EBI OLS."""
+
+    def __init__(self):
+        self.calls = []
+
+    def __call__(self, method, url, body):
+        self.calls.append((method, url))
+        if url.endswith("/hp"):
+            return 200, {
+                "ontologyId": "hp",
+                "config": {"baseUris": ["http://purl.obolibrary.org/obo/HP_"]},
+            }
+        if "hierarchicalAncestors" in url:
+            return 200, {
+                "_embedded": {
+                    "terms": [
+                        {"obo_id": "HP:0000001"},
+                        {"obo_id": "HP:0000118"},
+                        {"obo_id": None},  # dropped
+                    ]
+                }
+            }
+        return 404, {}
+
+
+def test_ols_resolver():
+    t = FakeOls()
+    r = OlsResolver(transport=t)
+    meta = r.ontology_meta("HP")
+    assert meta == {
+        "id": "HP",
+        "baseUri": "http://purl.obolibrary.org/obo/HP_",
+    }
+    anc = r.ancestors("HP:0000924", meta)
+    assert anc == {"HP:0000001", "HP:0000118"}
+    # IRI is double-encoded into the path
+    assert any("terms/http%253A" in u for _, u in t.calls)
+
+
+class FlakyOntoserver:
+    """Fails twice then answers — exercises the 10x retry loop."""
+
+    def __init__(self):
+        self.n = 0
+
+    def __call__(self, method, url, body):
+        self.n += 1
+        if self.n < 3:
+            return 500, {}
+        assert body["parameter"][0]["resource"]["compose"]["include"][0][
+            "filter"
+        ][0] == {"property": "concept", "op": "generalizes", "value": "123"}
+        return 200, {
+            "expansion": {"contains": [{"code": "123"}, {"code": "9"}]}
+        }
+
+
+def test_ontoserver_retry_and_prefixing():
+    t = FlakyOntoserver()
+    r = OntoserverResolver(transport=t, retry_sleep_s=0)
+    anc = r.ancestors("SNOMED:123", {"baseUri": "http://snomed.info/sct"})
+    assert t.n == 3
+    assert anc == {"SNOMED:123", "SNOMED:9"}
+
+
+def test_ontoserver_retries_on_transport_raise():
+    """A raising transport (urllib HTTPError, resets) is retryable, not
+    instantly fatal."""
+    calls = {"n": 0}
+
+    def flaky(method, url, body):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("connection reset")
+        return 200, {"expansion": {"contains": [{"code": "7"}]}}
+
+    r = OntoserverResolver(transport=flaky, retry_sleep_s=0)
+    assert r.ancestors("SNOMED:7", {}) == {"SNOMED:7"}
+    assert calls["n"] == 3
+
+
+def test_ontoserver_gives_up():
+    r = OntoserverResolver(
+        transport=lambda m, u, b: (500, {}), retries=3, retry_sleep_s=0
+    )
+    assert r.ancestors("SNOMED:1", {}) is None
+
+
+def _seeded_store():
+    store = MetadataStore()
+    store.upsert(
+        "individuals",
+        [
+            {
+                "id": "I0",
+                "sex": {"id": "HP:0000924", "label": "x"},
+                "_datasetId": "ds",
+            },
+            {
+                "id": "I1",
+                "sex": {"id": "SNOMED:123", "label": "y"},
+                "_datasetId": "ds",
+            },
+        ],
+    )
+    store.rebuild_indexes()
+    return store
+
+
+def test_term_tree_indexer_end_to_end():
+    store = _seeded_store()
+    onto = OntologyStore()
+    ols_t = FakeOls()
+    onto_t = FlakyOntoserver()
+    idx = TermTreeIndexer(
+        store,
+        onto,
+        ols=OlsResolver(transport=ols_t),
+        ontoserver=OntoserverResolver(transport=onto_t, retry_sleep_s=0),
+        workers=2,
+    )
+    stats = idx.run()
+    assert stats["resolved"] == 2 and stats["failed"] == 0
+    # ancestors include self; descendants inverted
+    assert "HP:0000001" in onto.term_ancestors("HP:0000924")
+    assert "HP:0000924" in onto.term_descendants("HP:0000001")
+    assert "SNOMED:9" in onto.term_ancestors("SNOMED:123")
+    # ontology metadata cached
+    assert onto.get_ontology("HP")["baseUri"].endswith("HP_")
+    assert onto.get_ontology("SNOMED")["id"] == "SNOMED"
+    # second run: everything cached, no new fetches
+    calls_before = len(ols_t.calls)
+    stats2 = idx.run()
+    assert stats2 == {"resolved": 0, "skipped": 2, "failed": 0}
+    assert len(ols_t.calls) == calls_before
+
+
+def test_indexer_unresolvable_prefix_counts_failed():
+    store = _seeded_store()
+    onto = OntologyStore()
+    dead = lambda m, u, b: (_ for _ in ()).throw(OSError("no egress"))
+    idx = TermTreeIndexer(
+        store,
+        onto,
+        ols=OlsResolver(transport=dead),
+        ontoserver=OntoserverResolver(
+            transport=dead, retries=1, retry_sleep_s=0
+        ),
+    )
+    stats = idx.run()
+    assert stats["resolved"] == 0
+    assert stats["failed"] == 2
+    # unresolved terms still expand to themselves in the filter path
+    assert onto.term_descendants("HP:0000924") == {"HP:0000924"}

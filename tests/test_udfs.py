@@ -1,0 +1,68 @@
+"""Scalar SQL UDFs (Athena-UDF parity): round-trips, wire-format checks,
+sqlite registration — mirrors AthenaUDFHandlerTest's compress/decompress
+and encrypt/decrypt coverage with a fake secrets provider."""
+
+import base64
+import zlib
+
+import pytest
+
+from sbeacon_tpu.metadata import udfs
+from sbeacon_tpu.metadata.store import MetadataStore
+
+KEY = base64.b64encode(bytes(range(16))).decode()  # AES-128 data key
+
+
+def secrets(name):
+    assert name == "beacon-key"
+    return KEY
+
+
+def test_compress_roundtrip_and_format():
+    for s in ("", "hello", "x" * 10_000, "unicode ✓ ∆"):
+        c = udfs.compress(s)
+        assert udfs.decompress(c) == s
+    # wire format: Base64 of raw zlib (Java Deflater default)
+    assert zlib.decompress(base64.b64decode(udfs.compress("abc"))) == b"abc"
+
+
+def test_encrypt_roundtrip_and_format():
+    for s in ("", "secret", "x" * 1000):
+        ct = udfs.encrypt(s, "beacon-key", secrets)
+        assert udfs.decrypt(ct, "beacon-key", secrets) == s
+    # AES/ECB is deterministic (the parity wire format)
+    assert udfs.encrypt("a", "beacon-key", secrets) == udfs.encrypt(
+        "a", "beacon-key", secrets
+    )
+    # ciphertext is block-aligned Base64
+    raw = base64.b64decode(udfs.encrypt("abc", "beacon-key", secrets))
+    assert len(raw) % 16 == 0
+
+
+def test_gcm_roundtrip_not_deterministic():
+    ct1 = udfs.encrypt_gcm("msg", "beacon-key", secrets)
+    ct2 = udfs.encrypt_gcm("msg", "beacon-key", secrets)
+    assert ct1 != ct2  # fresh nonce each call
+    assert udfs.decrypt_gcm(ct1, "beacon-key", secrets) == "msg"
+    assert udfs.decrypt_gcm(ct2, "beacon-key", secrets) == "msg"
+
+
+def test_env_secrets(monkeypatch):
+    monkeypatch.setenv("SBEACON_SECRET_BEACON_KEY", KEY)
+    assert udfs.env_secrets("beacon-key") == KEY
+    with pytest.raises(KeyError):
+        udfs.env_secrets("missing")
+
+
+def test_sqlite_registration():
+    store = MetadataStore()
+    udfs.register_udfs(store, secrets)
+    (got,) = store.query("SELECT decompress(compress('metadata sql'))")[0]
+    assert got == "metadata sql"
+    (ct,) = store.query("SELECT encrypt('pii', 'beacon-key')")[0]
+    (pt,) = store.query("SELECT decrypt(?, 'beacon-key')", [ct])[0]
+    assert pt == "pii"
+    (gpt,) = store.query(
+        "SELECT decrypt_gcm(encrypt_gcm('pii2', 'beacon-key'), 'beacon-key')"
+    )[0]
+    assert gpt == "pii2"
