@@ -99,19 +99,27 @@ class OlsResolver:
         enc = urllib.parse.quote_plus(urllib.parse.quote_plus(iri))
         url = (
             f"{self.base_url}/{prefix.lower()}/terms/{enc}"
-            "/hierarchicalAncestors"
+            "/hierarchicalAncestors?size=500"
         )
-        try:
-            status, doc = self.transport("GET", url, None)
-        except Exception as e:
-            log.warning("OLS ancestors failed for %s: %s", term, e)
-            return None
-        if status != 200:
-            return None
-        out = set()
-        for t in doc.get("_embedded", {}).get("terms", []):
-            if t.get("obo_id"):
-                out.add(t["obo_id"])
+        out: set[str] = set()
+        # OLS paginates (default page size 20): follow _links.next so
+        # deep closures (HPO/NCIT routinely exceed a page) aren't
+        # silently truncated into the persistent cache
+        for _ in range(100):  # hard page cap
+            try:
+                status, doc = self.transport("GET", url, None)
+            except Exception as e:
+                log.warning("OLS ancestors failed for %s: %s", term, e)
+                return None
+            if status != 200:
+                return None
+            for t in doc.get("_embedded", {}).get("terms", []):
+                if t.get("obo_id"):
+                    out.add(t["obo_id"])
+            nxt = doc.get("_links", {}).get("next", {}).get("href")
+            if not nxt:
+                break
+            url = nxt
         return out or None
 
 
@@ -135,7 +143,10 @@ class OntoserverResolver:
 
     def ancestors(self, term: str, meta: dict) -> set[str] | None:
         snomed = "SNOMED" in term.upper()
-        code = term.replace("SNOMED:", "")
+        # strip the CURIE prefix case-insensitively: 'snomed:123' must
+        # send code '123', not the whole term
+        prefix, sep, local = term.partition(":")
+        code = local if sep and prefix.upper() == "SNOMED" else term
         body = {
             "resourceType": "Parameters",
             "parameter": [

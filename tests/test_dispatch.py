@@ -1,0 +1,159 @@
+"""Cross-host dispatcher: worker protocol, routing, retries, and
+coordinator/single-engine equivalence."""
+
+import random
+
+import pytest
+
+from sbeacon_tpu.config import BeaconConfig, EngineConfig
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.parallel.dispatch import (
+    DistributedEngine,
+    WorkerError,
+    WorkerServer,
+)
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+
+def _engine(*dataset_ids, seed0=100):
+    eng = VariantEngine(BeaconConfig(engine=EngineConfig(microbatch=False)))
+    for k, ds in enumerate(dataset_ids):
+        rng = random.Random(seed0 + k)
+        recs = random_records(rng, chrom="1", n=120, n_samples=2)
+        eng.add_index(
+            build_index(
+                recs,
+                dataset_id=ds,
+                vcf_location=f"{ds}.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+    return eng
+
+
+PAYLOAD = VariantQueryPayload(
+    dataset_ids=[],
+    reference_name="1",
+    start_min=1,
+    start_max=1 << 30,
+    end_min=1,
+    end_max=1 << 30,
+    alternate_bases="N",
+    include_datasets="HIT",
+)
+
+
+@pytest.fixture()
+def cluster():
+    w1 = WorkerServer(_engine("dsA", "dsB", seed0=100)).start_background()
+    w2 = WorkerServer(_engine("dsC", seed0=200)).start_background()
+    try:
+        yield w1, w2
+    finally:
+        w1.shutdown()
+        w2.shutdown()
+
+
+def test_distributed_matches_single_engine(cluster):
+    w1, w2 = cluster
+    dist = DistributedEngine([w1.address, w2.address])
+    assert dist.datasets() == ["dsA", "dsB", "dsC"]
+    got = dist.search(PAYLOAD)
+    # reference: one engine holding all three shards
+    want = _engine("dsA", "dsB", seed0=100)
+    rng = random.Random(200)
+    want.add_index(
+        build_index(
+            random_records(rng, chrom="1", n=120, n_samples=2),
+            dataset_id="dsC",
+            vcf_location="dsC.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+    )
+    ref = sorted(
+        want.search(PAYLOAD), key=lambda r: (r.dataset_id, r.vcf_location)
+    )
+    assert [r.dumps() for r in got] == [r.dumps() for r in ref]
+
+
+def test_dataset_subset_routes_to_one_worker(cluster):
+    w1, w2 = cluster
+    dist = DistributedEngine([w1.address, w2.address])
+    import dataclasses
+
+    got = dist.search(dataclasses.replace(PAYLOAD, dataset_ids=["dsC"]))
+    assert [r.dataset_id for r in got] == ["dsC"]
+
+
+def test_local_engine_composes(cluster):
+    w1, _ = cluster
+    dist = DistributedEngine(
+        [w1.address], local=_engine("dsLocal", seed0=300)
+    )
+    assert dist.datasets() == ["dsA", "dsB", "dsLocal"]
+    got = dist.search(PAYLOAD)
+    assert {r.dataset_id for r in got} == {"dsA", "dsB", "dsLocal"}
+    assert "local=" in dist.index_fingerprint()
+
+
+def test_worker_fingerprint_in_coordinator(cluster):
+    w1, w2 = cluster
+    dist = DistributedEngine([w1.address, w2.address])
+    fp = dist.index_fingerprint()
+    assert w1.address in fp and w2.address in fp
+    assert "dsA" in fp  # worker fingerprints carry shard identity
+
+
+def test_retry_then_error():
+    calls = {"n": 0}
+
+    def flaky_post(url, doc, timeout_s):
+        calls["n"] += 1
+        raise OSError("refused")
+
+    def fake_get(url, timeout_s):
+        return 200, {"datasets": ["dsX"], "fingerprint": "f"}
+
+    dist = DistributedEngine(
+        ["http://127.0.0.1:1"], retries=2, post=flaky_post, get=fake_get
+    )
+    import dataclasses
+
+    with pytest.raises(WorkerError):
+        dist.search(dataclasses.replace(PAYLOAD, dataset_ids=["dsX"]))
+    assert calls["n"] == 3  # initial + 2 retries
+
+
+def test_stale_routes_refresh_on_miss(cluster):
+    w1, w2 = cluster
+    dist = DistributedEngine([w1.address])
+    assert dist.datasets() == ["dsA", "dsB"]  # cache populated
+    # dsC's worker joins after discovery: an explicit request must
+    # trigger a refresh, not a silent skip
+    dist.worker_urls.append(w2.address)
+    import dataclasses
+
+    got = dist.search(dataclasses.replace(PAYLOAD, dataset_ids=["dsC"]))
+    assert [r.dataset_id for r in got] == ["dsC"]
+
+
+def test_unreachable_worker_skipped_in_discovery():
+    w = WorkerServer(_engine("dsA")).start_background()
+    try:
+        dist = DistributedEngine([w.address, "http://127.0.0.1:1"])
+        assert dist.datasets() == ["dsA"]  # dead worker just drops out
+    finally:
+        w.shutdown()
+
+
+def test_worker_error_travels_to_coordinator(cluster):
+    w1, _ = cluster
+    dist = DistributedEngine([w1.address], retries=0)
+    # chromosome with no records is fine (empty), but a malformed payload
+    # must surface as WorkerError with the worker's message
+    status, out = __import__(
+        "sbeacon_tpu.parallel.dispatch", fromlist=["urllib_post"]
+    ).urllib_post(f"{w1.address}/search", {"bogus": 1}, 5)
+    assert status == 500 and "error" in out

@@ -101,6 +101,44 @@ def test_ontoserver_retries_on_transport_raise():
     assert calls["n"] == 3
 
 
+def test_ontoserver_lowercase_curie_strips_prefix():
+    seen = {}
+
+    def t(method, url, body):
+        seen["value"] = body["parameter"][0]["resource"]["compose"][
+            "include"
+        ][0]["filter"][0]["value"]
+        return 200, {"expansion": {"contains": [{"code": "1"}]}}
+
+    r = OntoserverResolver(transport=t, retry_sleep_s=0)
+    r.ancestors("snomed:123", {})
+    assert seen["value"] == "123"
+    r.ancestors("123456", {})  # bare numeric code: sent as-is
+    assert seen["value"] == "123456"
+
+
+def test_ols_follows_pagination():
+    def t(method, url, body):
+        if url.endswith("/hp"):
+            return 200, {
+                "ontologyId": "hp",
+                "config": {"baseUris": ["http://x/HP_"]},
+            }
+        if "page=2" in url:
+            return 200, {
+                "_embedded": {"terms": [{"obo_id": "HP:0000002"}]},
+                "_links": {},
+            }
+        return 200, {
+            "_embedded": {"terms": [{"obo_id": "HP:0000001"}]},
+            "_links": {"next": {"href": url + "&page=2"}},
+        }
+
+    r = OlsResolver(transport=t)
+    anc = r.ancestors("HP:0000924", r.ontology_meta("HP"))
+    assert anc == {"HP:0000001", "HP:0000002"}
+
+
 def test_ontoserver_gives_up():
     r = OntoserverResolver(
         transport=lambda m, u, b: (500, {}), retries=3, retry_sleep_s=0
