@@ -26,6 +26,7 @@ shape caps and byte-exact (blob, not hash) allele comparison.
 from __future__ import annotations
 
 import logging
+from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -335,6 +336,11 @@ class VariantEngine:
             )
         else:
             self._batcher = None
+        # persistent per-dataset scatter pool (serving hot path: no
+        # per-request thread churn)
+        self._scatter = ThreadPoolExecutor(
+            max_workers=32, thread_name_prefix="engine-scatter"
+        )
 
     # -- index management ---------------------------------------------------
 
@@ -384,8 +390,38 @@ class VariantEngine:
             responses = self._search(payload, sp)
         return responses
 
-    def _search(self, payload: VariantQueryPayload, sp):
+    def _device_rows(
+        self,
+        shard: VariantIndexShard,
+        dindex: "DeviceIndex",
+        spec: QuerySpec,
+        *,
+        ref_wildcard: bool = False,
+    ) -> np.ndarray:
+        """Matched row ids via the device kernel (micro-batched when
+        enabled), host fallback on window/record overflow."""
         eng = self.config.engine
+        if self._batcher is not None:
+            # concurrent searches against this shard coalesce into one
+            # kernel launch (serving micro-batcher, SURVEY.md §7)
+            res = self._batcher.submit(
+                dindex,
+                spec,
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+            )
+        else:
+            res = run_queries(
+                dindex,
+                [spec],
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+            )
+        if res.overflow[0] or res.n_matched[0] > eng.record_cap:
+            return host_match_rows(shard, spec, ref_wildcard=ref_wildcard)
+        return res.rows[0][res.rows[0] >= 0]
+
+    def _search(self, payload: VariantQueryPayload, sp):
         spec_base = QuerySpec(
             chrom=payload.reference_name,
             start_min=payload.start_min,
@@ -409,53 +445,54 @@ class VariantEngine:
         if not targets:
             return []
 
-        responses = []
-        for ds, vcf, shard, dindex, native in targets:
+        def _one_target(target):
+            ds, vcf, shard, dindex, native = target
             selected_idx = None
+            ref = spec_base.reference_bases
             if payload.selected_samples_only:
                 # selected-samples leaf (reference performQuery/
                 # lambda_function.py:43-46 switches to
-                # search_variants_in_samples): host path, sample-restricted
+                # search_variants_in_samples): row matching runs on device
+                # unless the ref carries an N wildcard (the one field where
+                # the in-samples regex semantics diverge from the exact
+                # kernel compare); counting is then sample-restricted in
+                # materialize_response via the genotype bit planes
                 wanted = payload.sample_names.get(ds, [])
                 universe = shard.meta.get("sample_names", [])
                 name_to_idx = {s: k for k, s in enumerate(universe)}
                 selected_idx = [
                     name_to_idx[s] for s in wanted if s in name_to_idx
                 ]
-                rows = host_match_rows(shard, spec_base, ref_wildcard=True)
+                if dindex is not None and (
+                    ref is None or "N" not in ref.upper()
+                ):
+                    rows = self._device_rows(
+                        shard, dindex, spec_base, ref_wildcard=True
+                    )
+                else:
+                    rows = host_match_rows(
+                        shard, spec_base, ref_wildcard=True
+                    )
             elif dindex is None:
                 rows = host_match_rows(shard, spec_base)
             else:
-                if self._batcher is not None:
-                    # concurrent searches against this shard coalesce into
-                    # one kernel launch (serving micro-batcher, SURVEY.md §7)
-                    res = self._batcher.submit(
-                        dindex,
-                        spec_base,
-                        window_cap=eng.window_cap,
-                        record_cap=eng.record_cap,
-                    )
-                else:
-                    res = run_queries(
-                        dindex,
-                        [spec_base],
-                        window_cap=eng.window_cap,
-                        record_cap=eng.record_cap,
-                    )
-                if res.overflow[0] or res.n_matched[0] > eng.record_cap:
-                    rows = host_match_rows(shard, spec_base)
-                else:
-                    rows = res.rows[0][res.rows[0] >= 0]
-            responses.append(
-                materialize_response(
-                    shard,
-                    rows,
-                    payload,
-                    chrom_label=native,
-                    dataset_id=ds,
-                    vcf_location=vcf,
-                    selected_idx=selected_idx,
-                )
+                rows = self._device_rows(shard, dindex, spec_base)
+            return materialize_response(
+                shard,
+                rows,
+                payload,
+                chrom_label=native,
+                dataset_id=ds,
+                vcf_location=vcf,
+                selected_idx=selected_idx,
             )
+
+        if len(targets) == 1:
+            responses = [_one_target(targets[0])]
+        else:
+            # per-dataset scatter (the reference's ThreadPoolExecutor(500)
+            # per-dataset dispatch, search_variants.py:77-118): overlaps
+            # the per-shard device round-trips instead of serialising them
+            responses = list(self._scatter.map(_one_target, targets))
         sp.note(targets=len(targets), responses=len(responses))
         return responses

@@ -238,3 +238,124 @@ def test_ref_wildcard_restricted(setup):
     assert got[0].exists == want.exists
     assert got[0].call_count == want.call_count
     assert got[0].variants == want.variants
+
+
+def test_selected_samples_uses_device_path(setup, monkeypatch):
+    """Ref without N routes row-matching through the kernel; host matcher
+    must not be consulted (except on overflow, absent here)."""
+    engine, recs = setup
+    import sbeacon_tpu.engine as eng_mod
+
+    def boom(*a, **kw):
+        raise AssertionError("host matcher called on device-eligible query")
+
+    monkeypatch.setattr(eng_mod, "host_match_rows", boom)
+    payload = VariantQueryPayload(
+        dataset_ids=["ds"],
+        reference_name="7",
+        start_min=900,
+        start_max=20_000,
+        end_min=0,
+        end_max=10**9,
+        alternate_bases="N",
+        requested_granularity="record",
+        include_datasets="ALL",
+        include_samples=True,
+        sample_names={"ds": SAMPLES[:3]},
+        selected_samples_only=True,
+    )
+    (got,) = engine.search(payload)
+    assert got.exists
+
+
+def test_selected_samples_device_path_with_ref(setup, monkeypatch):
+    """Non-N reference_bases routes to the device kernel AND matches the
+    oracle — the headline case the routing change enables."""
+    engine, recs = setup
+    import sbeacon_tpu.engine as eng_mod
+
+    # pick a ref that actually occurs so the query can hit
+    ref = next(r.ref for r in recs if "N" not in r.ref.upper())
+    payload = VariantQueryPayload(
+        dataset_ids=["ds"],
+        reference_name="7",
+        start_min=900,
+        start_max=500_000,
+        end_min=0,
+        end_max=10**9,
+        reference_bases=ref,
+        alternate_bases="N",
+        requested_granularity="record",
+        include_datasets="ALL",
+        include_samples=True,
+        sample_names={"ds": SAMPLES[:3]},
+        selected_samples_only=True,
+    )
+    want = oracle_search(
+        recs,
+        first_bp=payload.start_min,
+        last_bp=payload.start_max,
+        end_min=payload.end_min,
+        end_max=payload.end_max,
+        reference_bases=ref,
+        alternate_bases="N",
+        variant_type=None,
+        requested_granularity="record",
+        include_details=True,
+        include_samples=True,
+        sample_names=SAMPLES[:3],
+        dataset_id="ds",
+        vcf_location="x.vcf.gz",
+        chrom_label="7",
+        selected_sample_idx=[0, 1, 2],
+    )
+
+    def boom(*a, **kw):
+        raise AssertionError("host matcher called on device-eligible query")
+
+    monkeypatch.setattr(eng_mod, "host_match_rows", boom)
+    (got,) = engine.search(payload)
+    assert got.exists and want.exists  # the query must actually hit
+    assert got.variants == want.variants
+    assert got.call_count == want.call_count
+    assert got.sample_indices == want.sample_indices
+
+
+def test_selected_samples_n_ref_stays_on_host(setup):
+    """An N-wildcard ref (e.g. 'AN') must keep the host regex semantics."""
+    engine, recs = setup
+    payload = VariantQueryPayload(
+        dataset_ids=["ds"],
+        reference_name="7",
+        start_min=900,
+        start_max=200_000,
+        end_min=0,
+        end_max=10**9,
+        reference_bases="AN",
+        requested_granularity="record",
+        include_datasets="ALL",
+        include_samples=True,
+        sample_names={"ds": SAMPLES[:2]},
+        selected_samples_only=True,
+    )
+    (got,) = engine.search(payload)
+    want = oracle_search(
+        recs,
+        first_bp=payload.start_min,
+        last_bp=payload.start_max,
+        end_min=payload.end_min,
+        end_max=payload.end_max,
+        reference_bases="AN",
+        alternate_bases=None,
+        variant_type=None,
+        requested_granularity="record",
+        include_details=True,
+        include_samples=True,
+        sample_names=SAMPLES[:2],
+        dataset_id="ds",
+        vcf_location="x.vcf.gz",
+        chrom_label="7",
+        selected_sample_idx=[0, 1],
+    )
+    assert got.variants == want.variants
+    assert got.call_count == want.call_count
