@@ -15,9 +15,118 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+import jsonschema
+
 
 class RequestError(ValueError):
     """400-worthy request problem; message is user-facing."""
+
+
+# POST body schema — the requestBody.json / gVariantsRequestParameters.json
+# role (reference: shared_resources/schemas/, enforced per-route at e.g.
+# getGenomicVariants/lambda_function.py:13-15,27-37), authored compactly:
+# structure + enums + the allele patterns, with unknown extras tolerated
+# the way the reference's additionalProperties:true does.
+_ALLELE_PATTERN = r"^([ACGTUNRYSWKMBDHV\-\.acgtunryswkmbdhv]*)$"
+
+QUERY_BODY_SCHEMA = {
+    "type": "object",
+    "properties": {
+        "meta": {"type": "object"},
+        "query": {
+            "type": "object",
+            "properties": {
+                "requestedGranularity": {
+                    "enum": ["boolean", "count", "record", "aggregated"]
+                },
+                "includeResultsetResponses": {
+                    "enum": ["ALL", "HIT", "MISS", "NONE"]
+                },
+                "pagination": {
+                    "type": "object",
+                    "properties": {
+                        "skip": {"type": "integer", "minimum": 0},
+                        "limit": {"type": "integer", "minimum": 0},
+                    },
+                },
+                "filters": {
+                    "type": "array",
+                    "items": {
+                        "anyOf": [
+                            {"type": "string"},
+                            {
+                                "type": "object",
+                                "required": ["id"],
+                                "properties": {
+                                    "id": {"type": "string"},
+                                    "scope": {"type": "string"},
+                                    "includeDescendantTerms": {
+                                        "type": "boolean"
+                                    },
+                                    "similarity": {
+                                        "enum": [
+                                            "exact",
+                                            "high",
+                                            "medium",
+                                            "low",
+                                        ]
+                                    },
+                                },
+                            },
+                        ]
+                    },
+                },
+                "requestParameters": {
+                    "type": "object",
+                    "properties": {
+                        "assemblyId": {"type": "string"},
+                        "referenceName": {"type": "string"},
+                        "referenceBases": {
+                            "type": "string",
+                            "pattern": _ALLELE_PATTERN,
+                        },
+                        "alternateBases": {
+                            "type": "string",
+                            "pattern": _ALLELE_PATTERN,
+                        },
+                        "variantType": {"type": "string"},
+                        "start": {
+                            "type": "array",
+                            "items": {"type": "integer", "minimum": 0},
+                            "maxItems": 2,
+                        },
+                        "end": {
+                            "type": "array",
+                            "items": {"type": "integer", "minimum": 0},
+                            "maxItems": 2,
+                        },
+                        "variantMinLength": {
+                            "type": "integer",
+                            "minimum": 0,
+                        },
+                        "variantMaxLength": {
+                            "type": "integer",
+                            "minimum": 0,
+                        },
+                    },
+                },
+            },
+        },
+    },
+}
+
+_QUERY_VALIDATOR = jsonschema.Draft7Validator(QUERY_BODY_SCHEMA)
+
+
+def validate_query_body(body: dict) -> None:
+    """Schema-check a POST body before parsing (reference: jsonschema
+    validate at the top of every POST route)."""
+    errors = sorted(
+        _QUERY_VALIDATOR.iter_errors(body), key=lambda e: list(e.path)
+    )
+    if errors:
+        where = "/".join(str(p) for p in errors[0].path) or "body"
+        raise RequestError(f"invalid request at {where}: {errors[0].message}")
 
 
 def _int(value, name: str, default: int | None = None) -> int:
@@ -44,6 +153,13 @@ def _int_list(value, name: str) -> list[int]:
         return [int(p) for p in parts]
     except (TypeError, ValueError):
         raise RequestError(f"{name} must be a list of integers") from None
+
+
+def _upper(value):
+    """Allele case normalisation: the index hashes record alleles
+    uppercased, so queries must be uppercased too or lowercase input
+    (legal per the allele alphabet) silently never matches."""
+    return value.upper() if isinstance(value, str) else value
 
 
 def _parse_filters(raw) -> list[dict]:
@@ -126,6 +242,7 @@ def parse_request(
     req = BeaconRequest(method=method.upper())
     if req.method == "POST":
         params = body or {}
+        validate_query_body(params)
         query = params.get("query") or {}
         pagination = query.get("pagination") or {}
         rp = query.get("requestParameters") or {}
@@ -140,8 +257,8 @@ def parse_request(
         req.end = _int_list(rp.get("end"), "end")
         req.assembly_id = rp.get("assemblyId")
         req.reference_name = rp.get("referenceName")
-        req.reference_bases = rp.get("referenceBases")
-        req.alternate_bases = rp.get("alternateBases")
+        req.reference_bases = _upper(rp.get("referenceBases"))
+        req.alternate_bases = _upper(rp.get("alternateBases"))
         req.variant_type = rp.get("variantType")
         req.variant_min_length = _int(
             rp.get("variantMinLength"), "variantMinLength", 0
@@ -162,8 +279,8 @@ def parse_request(
         req.end = _int_list(params.get("end"), "end")
         req.assembly_id = params.get("assemblyId")
         req.reference_name = params.get("referenceName")
-        req.reference_bases = params.get("referenceBases")
-        req.alternate_bases = params.get("alternateBases")
+        req.reference_bases = _upper(params.get("referenceBases"))
+        req.alternate_bases = _upper(params.get("alternateBases"))
         req.variant_type = params.get("variantType")
         req.variant_min_length = _int(
             params.get("variantMinLength"), "variantMinLength", 0

@@ -420,3 +420,48 @@ def test_http_server_roundtrip(app):
     finally:
         server.shutdown()
         server.server_close()
+
+
+def test_post_body_schema_validation(app):
+    """POST bodies are schema-validated before parsing (reference:
+    jsonschema validate at the top of every POST route)."""
+    bad = [
+        # bad granularity enum
+        {"query": {"requestedGranularity": "bogus"}},
+        # alt bases outside the allele alphabet
+        {"query": {"requestParameters": {"alternateBases": "XYZ"}}},
+        # negative skip
+        {"query": {"pagination": {"skip": -1}}},
+        # filter object without id
+        {"query": {"filters": [{"scope": "individuals"}]}},
+        # 3-element start
+        {"query": {"requestParameters": {"start": [1, 2, 3]}}},
+        # non-integer start
+        {"query": {"requestParameters": {"start": ["x"]}}},
+        # includeResultsetResponses outside enum
+        {"query": {"includeResultsetResponses": "SOME"}},
+    ]
+    for body in bad:
+        status, out = app.handle("POST", "/individuals", body=body)
+        assert status == 400, body
+        assert "error" in out
+    # IUPAC codes and lowercase are legal allele characters
+    status, _ = app.handle(
+        "POST",
+        "/individuals",
+        body={"query": {"requestParameters": {"alternateBases": "acgtRY"}}},
+    )
+    assert status == 200
+
+
+def test_lowercase_alleles_normalised(app, tmp_path):
+    """Lowercase allele input must behave exactly as uppercase (the index
+    hashes record alleles uppercased)."""
+    rec, q = _hit_query(app)
+    q["query"]["requestParameters"]["alternateBases"] = (
+        q["query"]["requestParameters"].get("alternateBases", "N").lower()
+    )
+    q["query"]["requestParameters"]["referenceBases"] = rec.ref.lower()
+    status, body = app.handle("POST", "/g_variants", body=q)
+    assert status == 200
+    assert body["responseSummary"]["exists"] is True
