@@ -299,7 +299,7 @@ def config4_multi_dataset():
         for r in recs
         for a in r.alts
     }
-    return {
+    out = {
         "n_datasets": n_ds,
         "aggregate_s": round(best, 4),
         "responses": len(responses),
@@ -307,6 +307,30 @@ def config4_multi_dataset():
         "distinct_variants": distinct,
         "distinct_parity": distinct == len(brute),
     }
+    # device-sharded distinct count (sort-unique + psum, the SURVEY §2.5
+    # duplicateVariantSearch mapping) — timed against the host path
+    try:
+        from sbeacon_tpu.parallel.distinct import distinct_count_device
+        from sbeacon_tpu.parallel.mesh import make_mesh
+
+        mesh = make_mesh()
+        only_shards = [s for _, s in shards]
+        d = distinct_count_device(only_shards, mesh=mesh)  # warm
+        t_dev = _time_batch(
+            lambda: distinct_count_device(only_shards, mesh=mesh), repeats=3
+        )
+        t_host = _time_batch(
+            lambda: distinct_variant_count(only_shards), repeats=3
+        )
+        out["distinct_device"] = {
+            "value": d,
+            "parity": d == distinct,
+            "device_s": round(t_dev, 4),
+            "host_s": round(t_host, 4),
+        }
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    return out
 
 
 def config5_sv_indel(records, shard):

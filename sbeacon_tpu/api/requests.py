@@ -259,7 +259,7 @@ def parse_request(
         req.reference_name = rp.get("referenceName")
         req.reference_bases = _upper(rp.get("referenceBases"))
         req.alternate_bases = _upper(rp.get("alternateBases"))
-        req.variant_type = rp.get("variantType")
+        req.variant_type = _upper(rp.get("variantType"))
         req.variant_min_length = _int(
             rp.get("variantMinLength"), "variantMinLength", 0
         )
@@ -281,7 +281,7 @@ def parse_request(
         req.reference_name = params.get("referenceName")
         req.reference_bases = _upper(params.get("referenceBases"))
         req.alternate_bases = _upper(params.get("alternateBases"))
-        req.variant_type = params.get("variantType")
+        req.variant_type = _upper(params.get("variantType"))
         req.variant_min_length = _int(
             params.get("variantMinLength"), "variantMinLength", 0
         )

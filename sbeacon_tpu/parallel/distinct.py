@@ -1,0 +1,160 @@
+"""Device-sharded distinct-variant count: duplicateVariantSearch on mesh.
+
+The reference counts distinct variants by fanning bp-ranges (≤750 MB
+each) to 8 GB lambdas that insert ``pos + ref_alt`` strings into an
+``unordered_set`` (reference: duplicateVariantSearch.cpp:31-84;
+range packing initDuplicateVariantSearch.py:171-191). SURVEY.md §2.5
+maps this to device-sharded dedupe: **sort-unique per shard + cross-
+shard reduction via collectives**, which is what this module does:
+
+1. host: concatenate all shards' fixed-width keys
+   (chrom_code, pos, ref_hash, alt_hash, ref_len, alt_len) and partition
+   them into ``n_shards`` *disjoint* (code, pos) ranges — the reference's
+   range-packing role; rows with equal (code, pos) never straddle a cut,
+   so no duplicate pair can cross shards;
+2. device (shard_map over the mesh): lexsort the local key block, count
+   rows that differ from their predecessor (sort-unique), mask padding;
+3. ``psum`` over the mesh axis replaces the DynamoDB
+   ``VariantDuplicates`` atomic-DELETE barrier entirely — the total is
+   on every device when the one compiled program returns.
+
+Keys are hash-exact (fnv1a32 of each allele + lengths + position): a
+false merge needs two alleles at the same position with equal lengths
+and a double FNV collision. The host path
+(``ingest.pipeline.distinct_variant_count``) byte-verifies duplicate
+groups and serves as the oracle; tests assert equality.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..index.columnar import VariantIndexShard
+from ..utils.trace import span
+from .mesh import AXIS, make_mesh
+
+#: sentinel key rows sort last and are excluded from the count
+_PAD = np.iinfo(np.int32).max
+
+
+def shard_keys(shards: list[VariantIndexShard]) -> np.ndarray:
+    """[n, 6] int32 key matrix over all rows of all shards (the same key
+    the host exact counter groups by)."""
+    parts = []
+    for s in shards:
+        n = s.n_rows
+        codes = (
+            np.searchsorted(s.chrom_offsets, np.arange(n), side="right") - 1
+        ).astype(np.int32)
+        # everything int32 (the device default): the 32-bit FNV hashes
+        # ride as bit patterns — any total order groups equal keys, which
+        # is all sort-unique needs
+        parts.append(
+            np.stack(
+                [
+                    codes,
+                    s.cols["pos"].astype(np.int32),
+                    s.cols["ref_hash"].astype(np.uint32).view(np.int32),
+                    s.cols["alt_hash"].astype(np.uint32).view(np.int32),
+                    s.cols["ref_len"].astype(np.int32),
+                    s.cols["alt_len"].astype(np.int32),
+                ],
+                axis=1,
+            )
+        )
+    if not parts:
+        return np.zeros((0, 6), np.int32)
+    return np.concatenate(parts)
+
+
+def partition_keys(keys: np.ndarray, n_shards: int) -> np.ndarray:
+    """Sort by (code, pos) and pad-partition into n_shards equal blocks
+    whose cuts never split an equal-(code, pos) run — the range-packing
+    step, memory-bounded like ABS_MAX_DATA_SPLIT."""
+    n = len(keys)
+    order = np.lexsort((keys[:, 1], keys[:, 0]))
+    keys = keys[order]
+    cuts = [0]
+    target = -(-n // n_shards)  # ceil
+    for k in range(1, n_shards):
+        # monotonic: a long equal run may have pushed the previous cut
+        # past this one's target — never step backwards (a backwards cut
+        # would replay rows into two blocks and double-count)
+        c = max(min(n, k * target), cuts[-1])
+        # push the cut forward past any equal-(code,pos) run
+        while c < n and c > 0 and (
+            keys[c, 0] == keys[c - 1, 0] and keys[c, 1] == keys[c - 1, 1]
+        ):
+            c += 1
+        cuts.append(c)
+    cuts.append(n)
+    width = max(
+        (cuts[k + 1] - cuts[k] for k in range(n_shards)), default=0
+    )
+    # pad width to a power-of-two bucket so repeated counts of similar
+    # corpora reuse one compiled program instead of retracing per size
+    bucket = 256
+    while bucket < width:
+        bucket *= 2
+    width = bucket
+    out = np.full((n_shards, width, 6), _PAD, dtype=np.int32)
+    for k in range(n_shards):
+        blk = keys[cuts[k] : cuts[k + 1]]
+        out[k, : len(blk)] = blk
+    return out
+
+
+def _local_distinct(block):
+    """Per-device body: lexsort-unique count of one [1, width, 6] block,
+    psum over the mesh axis."""
+    blk = block[0]  # [width, 6]
+    order = jnp.lexsort(
+        (blk[:, 5], blk[:, 4], blk[:, 3], blk[:, 2], blk[:, 1], blk[:, 0])
+    )
+    srt = blk[order]
+    real = srt[:, 0] != _PAD
+    diff = jnp.any(srt[1:] != srt[:-1], axis=1)
+    first = jnp.concatenate([jnp.array([True]), diff])
+    local = jnp.sum(first & real)
+    return jax.lax.psum(local, AXIS)
+
+
+@lru_cache(maxsize=8)
+def _compiled_for(mesh: Mesh):
+    """One jitted shard_map program per mesh — rebuilding the closure per
+    call would defeat the jit cache and recompile every time."""
+    return jax.jit(
+        jax.shard_map(
+            _local_distinct,
+            mesh=mesh,
+            in_specs=P(AXIS),
+            out_specs=P(),
+        )
+    )
+
+
+def distinct_count_device(
+    shards: list[VariantIndexShard],
+    *,
+    mesh: Mesh | None = None,
+) -> int:
+    """Distinct (contig, pos, ref, alt) across shards, computed as one
+    mesh program (hash-exact; see module docstring)."""
+    with span("distinct.device") as sp:
+        keys = shard_keys(shards)
+        if len(keys) == 0:
+            return 0
+        mesh = mesh or make_mesh()
+        n_dev = mesh.devices.size
+        blocks = partition_keys(keys, n_dev)
+        sharding = NamedSharding(mesh, P(AXIS))
+        blocks_dev = jax.device_put(jnp.asarray(blocks), sharding)
+        fn = _compiled_for(mesh)
+        total = int(jax.device_get(fn(blocks_dev)))
+        sp.note(rows=len(keys), devices=n_dev)
+    return total

@@ -465,3 +465,42 @@ def test_lowercase_alleles_normalised(app, tmp_path):
     status, body = app.handle("POST", "/g_variants", body=q)
     assert status == 200
     assert body["responseSummary"]["exists"] is True
+
+
+def test_lowercase_variant_type_normalised(app):
+    """variantType is case-normalised like the allele fields."""
+    status, body = app.handle(
+        "POST",
+        "/g_variants",
+        body={
+            "query": {
+                "requestParameters": {
+                    "assemblyId": "GRCh38",
+                    "referenceName": "22",
+                    "start": [1],
+                    "end": [100000000],
+                    "variantType": "del",
+                },
+                "requestedGranularity": "boolean",
+            }
+        },
+    )
+    assert status == 200
+    # equivalence with the uppercase spelling, whatever the data holds
+    _, upper = app.handle(
+        "POST",
+        "/g_variants",
+        body={
+            "query": {
+                "requestParameters": {
+                    "assemblyId": "GRCh38",
+                    "referenceName": "22",
+                    "start": [1],
+                    "end": [100000000],
+                    "variantType": "DEL",
+                },
+                "requestedGranularity": "boolean",
+            }
+        },
+    )
+    assert body["responseSummary"] == upper["responseSummary"]
