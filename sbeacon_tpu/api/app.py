@@ -122,8 +122,12 @@ class BeaconApp:
             store.ontology = self.ontology
         self.store = store
         self.engine = engine or VariantEngine(self.config)
+        # ingestion always targets an engine that can host shards: a
+        # DistributedEngine coordinator exposes its local VariantEngine
+        # as .local (shard ownership lives on hosts, not the coordinator)
+        ingest_engine = getattr(self.engine, "local", None) or self.engine
         self.ingest = ingest or IngestService(
-            self.config, engine=self.engine, store=self.store
+            self.config, engine=ingest_engine, store=self.store
         )
         self.env = Envelopes(self.config.info)
         # async query job table (VariantQueries/VariantQueryResponses roles):

@@ -91,3 +91,55 @@ def start_background(app: BeaconApp, host: str = "127.0.0.1", port: int = 0):
     t = threading.Thread(target=server.serve_forever, daemon=True)
     t.start()
     return server, t
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m sbeacon_tpu.api.server`` — the deployment entry the
+    reference expresses as terraform apply (api.tf + lambda env blocks):
+    one process serving the full Beacon v2 surface over a disk-backed
+    store, optionally fronting remote worker hosts (--worker)."""
+    import argparse
+
+    from ..config import BeaconConfig
+
+    p = argparse.ArgumentParser(description="TPU-native Beacon v2 server")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5000)
+    p.add_argument(
+        "--data-root",
+        default=None,
+        help="storage root (default: BeaconConfig/./beacon_data)",
+    )
+    p.add_argument(
+        "--worker",
+        action="append",
+        default=[],
+        metavar="URL",
+        help="remote worker base URL (repeatable); queries fan out across "
+        "workers + local shards",
+    )
+    args = p.parse_args(argv)
+
+    config = BeaconConfig.from_env(args.data_root)
+    engine = None
+    if args.worker:
+        from ..engine import VariantEngine
+        from ..parallel.dispatch import DistributedEngine
+
+        # the local VariantEngine hosts this machine's shards; BeaconApp
+        # wires ingestion to it (engine.local) while queries fan out
+        # through the coordinator
+        engine = DistributedEngine(
+            args.worker, local=VariantEngine(config), config=config
+        )
+    app = BeaconApp(config, engine=engine)
+    n = app.ingest.load_all()
+    print(
+        f"beacon serving on {args.host}:{args.port} "
+        f"({n} index shards loaded, {len(args.worker)} workers)"
+    )
+    serve(app, host=args.host, port=args.port)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

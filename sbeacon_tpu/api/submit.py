@@ -134,6 +134,29 @@ def submit_dataset(
     if body.get("index", False):
         app.store.rebuild_indexes()
         completed.append("Rebuilt indexes")
+        rcfg = app.config.resolvers
+        if rcfg.enabled:
+            # ontology closure build (the indexer's index_terms_tree,
+            # reference indexer:60-222); failures per-term are logged and
+            # counted, never fatal to the submission
+            from ..metadata.resolvers import (
+                OlsResolver,
+                OntoserverResolver,
+                TermTreeIndexer,
+            )
+
+            stats = TermTreeIndexer(
+                app.store,
+                app.ontology,
+                ols=OlsResolver(rcfg.ols_url),
+                ontoserver=OntoserverResolver(rcfg.ontoserver_url),
+                workers=rcfg.workers,
+            ).run()
+            completed.append(
+                "Resolved ontology closures "
+                f"({stats['resolved']} new, {stats['skipped']} cached, "
+                f"{stats['failed']} failed)"
+            )
 
     # ingestion pipeline kick (unconditional, unlike the reference's
     # commented-out SNS publish)

@@ -136,12 +136,36 @@ class IngestConfig:
     export_portable: bool = True
 
 
+# canonical external-service endpoints (reference indexer:40-42); the
+# resolver clients in metadata/resolvers.py import these — single source
+DEFAULT_OLS_URL = "https://www.ebi.ac.uk/ols/api/ontologies"
+DEFAULT_ONTOSERVER_URL = (
+    "https://r4.ontoserver.csiro.au/fhir/ValueSet/$expand"
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class ResolverConfig:
+    """External ontology resolution (the indexer's OLS/Ontoserver calls,
+    reference indexer/lambda_function.py:40-42). Off by default: an
+    air-gapped deployment must not stall submissions on network timeouts;
+    closures can also be loaded offline via OntologyStore."""
+
+    enabled: bool = False
+    ols_url: str = DEFAULT_OLS_URL
+    ontoserver_url: str = DEFAULT_ONTOSERVER_URL
+    workers: int = 8
+
+
 @dataclasses.dataclass(frozen=True)
 class BeaconConfig:
     info: BeaconInfo = dataclasses.field(default_factory=BeaconInfo)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
     engine: EngineConfig = dataclasses.field(default_factory=EngineConfig)
     ingest: IngestConfig = dataclasses.field(default_factory=IngestConfig)
+    resolvers: ResolverConfig = dataclasses.field(
+        default_factory=ResolverConfig
+    )
 
     @staticmethod
     def from_env(root: str | os.PathLike | None = None) -> "BeaconConfig":
@@ -170,7 +194,18 @@ class BeaconConfig:
                 "off",
             )
         engine = EngineConfig(**eng_over)
-        return BeaconConfig(info=info, storage=storage, engine=engine)
+        resolvers = ResolverConfig(
+            enabled=env.get("BEACON_RESOLVE_ONTOLOGIES", "").lower()
+            in ("1", "true", "yes", "on"),
+            ols_url=env.get("BEACON_OLS_URL", DEFAULT_OLS_URL),
+            ontoserver_url=env.get(
+                "BEACON_ONTOSERVER_URL", DEFAULT_ONTOSERVER_URL
+            ),
+            workers=int(env.get("BEACON_RESOLVER_WORKERS", "8")),
+        )
+        return BeaconConfig(
+            info=info, storage=storage, engine=engine, resolvers=resolvers
+        )
 
     def dumps(self) -> str:
         d = dataclasses.asdict(self)

@@ -32,8 +32,11 @@ log = logging.getLogger(__name__)
 #: transport signature: (method, url, json_body|None) -> (status, parsed json)
 Transport = Callable[[str, str, dict | None], tuple[int, dict]]
 
-DEFAULT_OLS = "https://www.ebi.ac.uk/ols/api/ontologies"
-DEFAULT_ONTOSERVER = "https://r4.ontoserver.csiro.au/fhir/ValueSet/$expand"
+from ..config import (
+    DEFAULT_OLS_URL as DEFAULT_OLS,
+    DEFAULT_ONTOSERVER_URL as DEFAULT_ONTOSERVER,
+)
+
 SNOMED_BASE_URI = "http://snomed.info/sct"
 
 def urllib_transport(method: str, url: str, body: dict | None = None):

@@ -324,3 +324,36 @@ def init_multihost(
         num_processes=num_processes,
         process_id=process_id,
     )
+
+
+def main(argv: list[str] | None = None) -> None:
+    """``python -m sbeacon_tpu.parallel.dispatch`` — run one worker host:
+    load this host's index shards and serve the typed-payload protocol."""
+    import argparse
+
+    from ..config import BeaconConfig
+    from ..engine import VariantEngine
+    from ..ingest import IngestService
+
+    p = argparse.ArgumentParser(description="beacon query worker host")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--port", type=int, default=5100)
+    p.add_argument("--data-root", default=None)
+    args = p.parse_args(argv)
+
+    config = BeaconConfig.from_env(args.data_root)
+    engine = VariantEngine(config)
+    n = IngestService(config, engine=engine).load_all()
+    worker = WorkerServer(engine, host=args.host, port=args.port)
+    print(
+        f"worker serving on {args.host}:{args.port} ({n} shards, "
+        f"datasets: {', '.join(engine.datasets()) or 'none'})"
+    )
+    try:
+        worker.server.serve_forever()
+    finally:
+        worker.server.server_close()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

@@ -157,3 +157,54 @@ def test_worker_error_travels_to_coordinator(cluster):
         "sbeacon_tpu.parallel.dispatch", fromlist=["urllib_post"]
     ).urllib_post(f"{w1.address}/search", {"bogus": 1}, 5)
     assert status == 500 and "error" in out
+
+
+def test_cli_help_entrypoints():
+    """Deployment CLIs exist: python -m sbeacon_tpu.api.server / .parallel.dispatch."""
+    import subprocess
+    import sys
+
+    for mod in ("sbeacon_tpu.api.server", "sbeacon_tpu.parallel.dispatch"):
+        out = subprocess.run(
+            [sys.executable, "-m", mod, "--help"],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        )
+        assert out.returncode == 0, out.stderr
+        assert "--port" in out.stdout
+
+
+def test_app_ingest_targets_local_engine(tmp_path, cluster):
+    """A BeaconApp over a DistributedEngine must ingest into the local
+    VariantEngine (the coordinator has no add_index) and then serve the
+    new dataset alongside worker datasets."""
+    import dataclasses
+
+    from sbeacon_tpu.api.app import BeaconApp
+    from sbeacon_tpu.config import BeaconConfig, StorageConfig
+    from sbeacon_tpu.testing import make_test_vcf
+
+    w1, _ = cluster
+    cfg = BeaconConfig(storage=StorageConfig(root=tmp_path / "coord"))
+    cfg.storage.ensure()
+    local = VariantEngine(cfg)
+    dist = DistributedEngine([w1.address], local=local, config=cfg)
+    app = BeaconApp(cfg, engine=dist)
+    vcf = tmp_path / "l.vcf.gz"
+    make_test_vcf(str(vcf), seed=5, chroms=("1",), n_per_chrom=60)
+    status, out = app.handle(
+        "POST",
+        "/submit",
+        body={
+            "datasetId": "dsLocal",
+            "assemblyId": "GRCh38",
+            "vcfLocations": [str(vcf)],
+            "dataset": {"name": "local"},
+        },
+    )
+    assert status == 200, out
+    assert "dsLocal" in local.datasets()
+    assert set(dist.datasets()) >= {"dsA", "dsB", "dsLocal"}
+    got = dist.search(PAYLOAD)
+    assert {r.dataset_id for r in got} == {"dsA", "dsB", "dsLocal"}

@@ -212,3 +212,61 @@ def test_indexer_unresolvable_prefix_counts_failed():
     assert stats["failed"] == 2
     # unresolved terms still expand to themselves in the filter path
     assert onto.term_descendants("HP:0000924") == {"HP:0000924"}
+
+def test_submit_runs_indexer_when_enabled(monkeypatch):
+    """'index': true + resolvers.enabled runs the closure build as part
+    of submission (the reference's post-submit indexer invoke)."""
+    import dataclasses
+
+    from sbeacon_tpu.api.app import BeaconApp
+    from sbeacon_tpu.config import BeaconConfig, ResolverConfig
+    import sbeacon_tpu.metadata.resolvers as R
+
+    app = BeaconApp()
+    app.config = dataclasses.replace(
+        app.config, resolvers=ResolverConfig(enabled=True)
+    )
+    monkeypatch.setattr(
+        R.OlsResolver, "ontology_meta",
+        lambda self, p: {"id": p, "baseUri": f"http://x/{p}_"},
+    )
+    monkeypatch.setattr(
+        R.OlsResolver, "ancestors",
+        lambda self, term, meta: {f"{term.split(':')[0]}:ROOT"},
+    )
+    status, out = app.handle(
+        "POST",
+        "/submit",
+        body={
+            "datasetId": "ds",
+            "assemblyId": "GRCh38",
+            "vcfLocations": [],
+            "dataset": {"name": "d"},
+            "individuals": [
+                {"id": "I0", "sex": {"id": "HP:0000001", "label": "x"}}
+            ],
+            "index": True,
+        },
+    )
+    assert status == 200, out
+    assert any("Resolved ontology closures" in c for c in out["completed"])
+    assert "HP:ROOT" in app.ontology.term_ancestors("HP:0000001")
+
+
+def test_submit_skips_indexer_by_default():
+    from sbeacon_tpu.api.app import BeaconApp
+
+    app = BeaconApp()
+    status, out = app.handle(
+        "POST",
+        "/submit",
+        body={
+            "datasetId": "ds",
+            "assemblyId": "GRCh38",
+            "vcfLocations": [],
+            "dataset": {"name": "d"},
+            "index": True,
+        },
+    )
+    assert status == 200
+    assert not any("ontology" in c.lower() for c in out["completed"])
