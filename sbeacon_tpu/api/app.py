@@ -126,6 +126,13 @@ class BeaconApp:
         # DistributedEngine coordinator exposes its local VariantEngine
         # as .local (shard ownership lives on hosts, not the coordinator)
         ingest_engine = getattr(self.engine, "local", None) or self.engine
+        if ingest is None and not hasattr(ingest_engine, "add_index"):
+            # fail at wiring time, not as an opaque 500 on first /submit
+            raise ValueError(
+                "engine cannot host index shards (no add_index): pass a "
+                "DistributedEngine with local=VariantEngine(...), or an "
+                "explicit ingest= service"
+            )
         self.ingest = ingest or IngestService(
             self.config, engine=ingest_engine, store=self.store
         )

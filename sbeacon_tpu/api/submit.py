@@ -93,11 +93,23 @@ def submit_dataset(
         vcf_locations = existing.get("_vcfLocations", [])
         chrom_map = existing.get("_vcfChromosomeMap", [])
 
+    groups_given = body.get("vcfGroups")
+    if groups_given is not None:
+        # an explicit grouping must partition vcfLocations exactly —
+        # a spelling mismatch or omission would silently skew sampleCount
+        flat = [str(v) for grp in groups_given for v in grp]
+        if sorted(flat) != sorted(str(v) for v in vcf_locations):
+            raise RequestError(
+                "vcfGroups must partition vcfLocations exactly "
+                "(every VCF in exactly one group, same spelling)"
+            )
+
     if body.get("dataset") is not None or (
-        existing and body.get("vcfLocations")
+        existing and (body.get("vcfLocations") or groups_given)
     ):
-        # a PATCH carrying only new vcfLocations must still land them on
-        # the stored doc, else they verify but never persist/summarise
+        # a PATCH carrying only new vcfLocations (or only a corrected
+        # vcfGroups) must still land on the stored doc, else it verifies
+        # but never persists/summarises
         doc = dict(existing or {})
         doc.update(body.get("dataset") or {})
         doc["id"] = dataset_id
@@ -106,6 +118,27 @@ def submit_dataset(
             (existing or {}).get("_assemblyId", "UNKNOWN"),
         )
         doc["_vcfLocations"] = vcf_locations
+        # default: one group holding every VCF — all VCFs share one
+        # sample cohort unless the submitter says otherwise (reference
+        # submitDataset:93 vcfGroups = [vcfLocations]). A stored default
+        # (explicit flag unset) is recomputed whenever vcfLocations
+        # change; a submitter-specified grouping is kept only while it
+        # still matches the locations.
+        if groups_given is not None:
+            doc["_vcfGroups"] = groups_given
+            doc["_vcfGroupsExplicit"] = True
+        else:
+            stored = (existing or {}).get("_vcfGroups")
+            stored_flat = sorted(
+                str(v) for grp in (stored or []) for v in grp
+            )
+            keep = (
+                (existing or {}).get("_vcfGroupsExplicit")
+                and stored_flat == sorted(str(v) for v in vcf_locations)
+            )
+            if not keep:
+                doc["_vcfGroups"] = [list(vcf_locations)]
+                doc["_vcfGroupsExplicit"] = False
         doc["_vcfChromosomeMap"] = chrom_map
         app.store.upsert("datasets", [doc])
         completed.append("Added dataset metadata")

@@ -217,15 +217,28 @@ class SummarisationPipeline:
 
     # -- dataset stage ------------------------------------------------------
 
-    def summarise_dataset(self, dataset_id: str, vcf_locations: list[str]):
+    def summarise_dataset(
+        self,
+        dataset_id: str,
+        vcf_locations: list[str],
+        vcf_groups: list[list[str]] | None = None,
+    ):
         """Summarise every VCF, compute dataset-level stats (distinct
         variants across VCFs = the duplicateVariantSearch role), pin
-        shards to the engine; returns the stats dict."""
+        shards to the engine; returns the stats dict.
+
+        ``vcf_groups`` partitions the VCFs into groups sharing one sample
+        cohort (VCFs split by chromosome); samples are counted once per
+        group (reference summariseDataset:87-124), and the default is ONE
+        group holding every VCF (reference submitDataset:93
+        ``vcfGroups = [vcfLocations]``)."""
         self.ledger.start_dataset(dataset_id, vcf_locations)
         shards = []
+        shard_by_vcf: dict[str, VariantIndexShard] = {}
         for vcf in vcf_locations:
             shard = self.summarise_vcf(dataset_id, vcf)
             shards.append(shard)
+            shard_by_vcf[str(vcf)] = shard
             if self.engine is not None:
                 self.engine.add_index(shard)
 
@@ -233,10 +246,28 @@ class SummarisationPipeline:
             shards, max_range_bytes=self.config.ingest.max_range_bytes
         )
         call_count = sum(s.meta["call_count"] for s in shards)
-        # sample count: once per VCF group; a plain submission has one
-        # group per VCF (reference summariseDataset:87-124 counts samples
-        # once per vcfGroup)
-        sample_count = sum(s.meta["sample_count"] for s in shards)
+        # sample count: once per VCF group (all VCFs in a group carry the
+        # same cohort — they are chromosome splits). A grouping that does
+        # not partition the summarised VCFs would silently skew the count,
+        # so it degrades to the default one-group-of-everything with a
+        # warning (the API layer rejects bad groupings at submit).
+        groups = vcf_groups if vcf_groups else [list(vcf_locations)]
+        flat = sorted(str(v) for grp in groups for v in grp)
+        if flat != sorted(shard_by_vcf):
+            if vcf_groups:
+                log.warning(
+                    "vcf_groups does not partition the dataset's VCFs; "
+                    "falling back to one group (dataset %s)",
+                    dataset_id,
+                )
+            groups = [list(shard_by_vcf)]
+        sample_count = 0
+        for grp in groups:
+            for vcf in grp:
+                s = shard_by_vcf.get(str(vcf))
+                if s is not None:
+                    sample_count += s.meta["sample_count"]
+                    break
         self.ledger.finish_dataset(
             dataset_id,
             variant_count=distinct,

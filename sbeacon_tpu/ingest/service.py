@@ -88,7 +88,9 @@ class IngestService:
         vcfs = doc.get("_vcfLocations", [])
         if not vcfs:
             return []
-        stats = self.pipeline.summarise_dataset(dataset_id, vcfs)
+        stats = self.pipeline.summarise_dataset(
+            dataset_id, vcfs, vcf_groups=doc.get("_vcfGroups")
+        )
         return [
             f"Summarised {len(vcfs)} VCF(s): "
             f"{stats['variantCount']} distinct variants, "

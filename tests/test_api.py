@@ -504,3 +504,82 @@ def test_lowercase_variant_type_normalised(app):
         },
     )
     assert body["responseSummary"] == upper["responseSummary"]
+
+
+def test_vcf_groups_validation_and_patch(app, tmp_path):
+    """vcfGroups must partition vcfLocations; PATCH semantics: explicit
+    groups persist, defaults recompute when locations change, and a PATCH
+    carrying only vcfGroups lands."""
+    from sbeacon_tpu.testing import make_test_vcf
+
+    v1 = str(tmp_path / "g1.vcf.gz")
+    v2 = str(tmp_path / "g2.vcf.gz")
+    make_test_vcf(v1, seed=61, chroms=("1",), n_per_chrom=30)
+    make_test_vcf(v2, seed=62, chroms=("2",), n_per_chrom=30)
+
+    # bad grouping rejected at submit
+    s, out = app.handle(
+        "POST",
+        "/submit",
+        body={
+            "datasetId": "dsg",
+            "assemblyId": "GRCh38",
+            "dataset": {"name": "g"},
+            "vcfLocations": [v1, v2],
+            "vcfGroups": [[v1]],  # v2 missing
+        },
+    )
+    assert s == 400 and "vcfGroups" in str(out)
+
+    # default grouping: one group of everything
+    s, _ = app.handle(
+        "POST",
+        "/submit",
+        body={
+            "datasetId": "dsg",
+            "assemblyId": "GRCh38",
+            "dataset": {"name": "g"},
+            "vcfLocations": [v1, v2],
+        },
+    )
+    assert s == 200
+    doc = app.store.get_by_id("datasets", "dsg")
+    assert doc["_vcfGroups"] == [[v1, v2]]
+    assert not doc["_vcfGroupsExplicit"]
+
+    # PATCH carrying only vcfGroups lands (per-VCF cohorts)
+    s, _ = app.handle(
+        "PATCH",
+        "/submit",
+        body={"datasetId": "dsg", "vcfGroups": [[v1], [v2]]},
+    )
+    assert s == 200
+    doc = app.store.get_by_id("datasets", "dsg")
+    assert doc["_vcfGroups"] == [[v1], [v2]]
+    assert doc["_vcfGroupsExplicit"]
+
+    # PATCH shrinking vcfLocations without vcfGroups: the now-mismatched
+    # explicit grouping is replaced by a fresh default, not kept stale
+    s, _ = app.handle(
+        "PATCH",
+        "/submit",
+        body={"datasetId": "dsg", "vcfLocations": [v1]},
+    )
+    assert s == 200
+    doc = app.store.get_by_id("datasets", "dsg")
+    assert doc["_vcfGroups"] == [[v1]]
+    assert not doc["_vcfGroupsExplicit"]
+
+
+def test_app_rejects_shardless_engine():
+    """An engine that cannot host shards fails at wiring, not on first
+    submit."""
+
+    class QueryOnly:
+        def search(self, p):
+            return []
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="add_index"):
+        BeaconApp(engine=QueryOnly())

@@ -166,10 +166,18 @@ def test_dataset_stage_distinct_count(corpus, tmp_path):
         for alt in r.alts
     }
     assert stats["variantCount"] == len(brute)
-    assert stats["sampleCount"] == 6  # 3 per VCF, one group each
+    # default grouping = ONE group of all VCFs (reference submitDataset:93
+    # vcfGroups=[vcfLocations]): samples counted once, not per VCF
+    assert stats["sampleCount"] == 3
     job = pipe.ledger.dataset_job("ds")
     assert job["state"] == "complete"
     assert job["variant_count"] == len(brute)
+
+    # explicit per-VCF groups (distinct cohorts) count each group once
+    stats2 = pipe.summarise_dataset(
+        "ds", [str(vcf), str(vcf2)], vcf_groups=[[str(vcf)], [str(vcf2)]]
+    )
+    assert stats2["sampleCount"] == 6
 
 
 def test_resume_after_crash(corpus, monkeypatch):
