@@ -20,6 +20,19 @@ from .bgzf import BgzfWriter
 
 _CALLS = re.compile(r"[0-9]+")
 
+#: GT-string -> call tuple memo (cohorts use a handful of GT spellings;
+#: bounded against pathological cardinality)
+_CALLS_MEMO: dict[str, tuple[int, ...]] = {}
+
+
+def _calls_for(gt: str) -> tuple[int, ...]:
+    r = _CALLS_MEMO.get(gt)
+    if r is None:
+        r = tuple(int(m) for m in _CALLS.findall(gt))
+        if len(_CALLS_MEMO) < 1 << 16:
+            _CALLS_MEMO[gt] = r
+    return r
+
 
 @dataclass
 class VcfRecord:
@@ -43,7 +56,7 @@ class VcfRecord:
         """
         calls: list[int] = []
         for gt in self.genotypes:
-            calls.extend(int(m) for m in _CALLS.findall(gt))
+            calls.extend(_calls_for(gt))
         return calls
 
     def effective_ac(self) -> list[int]:

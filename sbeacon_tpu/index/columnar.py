@@ -33,7 +33,7 @@ from pathlib import Path
 
 import numpy as np
 
-from ..genomics.vcf import VcfRecord
+from ..genomics.vcf import VcfRecord, _calls_for
 from ..utils.chrom import chromosome_code
 
 N_CHROM_CODES = 26  # codes 1..25 valid; offsets array has 27 entries
@@ -286,7 +286,8 @@ def build_index(
     # cache per-record derived values
     an_cache: dict[int, int] = {}
     ac_cache: dict[int, list[int]] = {}
-    calls_cache: dict[int, list[int]] = {}
+    # rec_ord -> _gt_matrix result (M, ntok, tok1, tok2, tok_over)
+    calls_cache: dict[int, tuple] = {}
 
     for i, (code, pos, rec_ord, alt_ord, rec) in enumerate(rows):
         alt = rec.alts[alt_ord]
@@ -319,26 +320,21 @@ def build_index(
         alt_parts.append(alt.encode())
         if gt_bits is not None and rec.genotypes:
             if rec_ord not in calls_cache:
-                calls_cache[rec_ord] = [
-                    [int(t) for t in _split_gt(gt)] for gt in rec.genotypes
-                ]
+                calls_cache[rec_ord] = _gt_matrix(
+                    rec.genotypes, gt_words
+                )
             allele = alt_ord + 1
-            for s_idx, toks in enumerate(calls_cache[rec_ord]):
-                bit = np.uint32(1 << (s_idx % 32))
-                w = s_idx // 32
-                copies = toks.count(allele)
-                if copies >= 1:
-                    gt_bits[i, w] |= bit
-                if copies >= 2:
-                    gt_bits2[i, w] |= bit
-                if copies > 2:  # ploidy > 2: keep the exact count
-                    gt_overflow.append((i, s_idx, copies))
-                if len(toks) >= 1:
-                    tok_bits1[i, w] |= bit
-                if len(toks) >= 2:
-                    tok_bits2[i, w] |= bit
-                if len(toks) > 2:
-                    tok_overflow.append((i, s_idx, len(toks)))
+            M, ntok, tok1, tok2, tok_over = calls_cache[rec_ord]
+            copies = (M == allele).sum(axis=1).astype(np.int32)
+            gt_bits[i] = _pack_bits(copies >= 1, gt_words)
+            gt_bits2[i] = _pack_bits(copies >= 2, gt_words)
+            for s_idx in np.nonzero(copies > 2)[0]:
+                # ploidy > 2: keep the exact count
+                gt_overflow.append((i, int(s_idx), int(copies[s_idx])))
+            tok_bits1[i] = tok1
+            tok_bits2[i] = tok2
+            for s_idx, t in tok_over:
+                tok_overflow.append((i, s_idx, t))
 
     # chrom offsets: chrom_offsets[c] = first row of code c
     codes = np.array([r[0] for r in rows], dtype=np.int32)
@@ -396,10 +392,44 @@ def build_index(
     return shard
 
 
-def _split_gt(gt: str) -> list[str]:
-    import re
+# GT tokenization is shared with the oracle path (genomics/vcf._calls_for,
+# the reference's get_all_calls regex semantics) so the plane builder and
+# the CPU oracle can never drift apart on genotype spellings.
 
-    return [t for t in re.split(r"[|/]", gt) if t.isdigit()]
+
+def _pack_bits(mask: np.ndarray, words: int) -> np.ndarray:
+    """bool[n_samples] -> uint32[words], bit s = sample s (little-bit
+    order within each word, matching the scalar ``1 << (s % 32)``)."""
+    padded = np.zeros(words * 32, dtype=np.uint32)
+    padded[: len(mask)] = mask
+    return (padded.reshape(words, 32) << np.arange(32, dtype=np.uint32)).sum(
+        axis=1, dtype=np.uint32
+    )
+
+
+def _gt_matrix(genotypes: list[str], gt_words: int):
+    """Per-record genotype parse, done once and shared by all alt rows:
+    (calls matrix [n_samples, max_ploidy] with -1 padding, token counts,
+    packed tok>=1 / tok>=2 planes, [(sample, tokens)] overflow)."""
+    calls = [_calls_for(gt) for gt in genotypes]
+    n = len(calls)
+    ploidy = max((len(c) for c in calls), default=0)
+    if ploidy and all(len(c) == ploidy for c in calls):
+        # uniform ploidy (the overwhelmingly common case): one array call
+        M = np.array(calls, dtype=np.int32)
+        ntok = np.full(n, ploidy, dtype=np.int32)
+    else:
+        M = np.full((n, max(ploidy, 1)), -1, dtype=np.int32)
+        ntok = np.zeros(n, dtype=np.int32)
+        for s, toks in enumerate(calls):
+            ntok[s] = len(toks)
+            M[s, : len(toks)] = toks
+    tok1 = _pack_bits(ntok >= 1, gt_words)
+    tok2 = _pack_bits(ntok >= 2, gt_words)
+    tok_over = [
+        (int(s), int(ntok[s])) for s in np.nonzero(ntok > 2)[0]
+    ]
+    return M, ntok, tok1, tok2, tok_over
 
 
 def save_index(shard: VariantIndexShard, path: str | Path) -> None:
