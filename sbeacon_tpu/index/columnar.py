@@ -413,8 +413,9 @@ def _gt_matrix(genotypes: list[str], gt_words: int):
     packed tok>=1 / tok>=2 planes, [(sample, tokens)] overflow)."""
     calls = [_calls_for(gt) for gt in genotypes]
     n = len(calls)
-    ploidy = max((len(c) for c in calls), default=0)
-    if ploidy and all(len(c) == ploidy for c in calls):
+    lens = [len(c) for c in calls]
+    ploidy = max(lens, default=0)
+    if ploidy and min(lens) == ploidy:
         # uniform ploidy (the overwhelmingly common case): one array call
         M = np.array(calls, dtype=np.int32)
         ntok = np.full(n, ploidy, dtype=np.int32)
