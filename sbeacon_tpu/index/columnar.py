@@ -283,17 +283,21 @@ def build_index(
     # rec_id must be nondecreasing in row order for the windowed
     # first-match-per-record scan on device; re-number by first appearance.
     rec_renumber: dict[int, int] = {}
+    used_records: list = []  # record object per renumbered id
     # cache per-record derived values
     an_cache: dict[int, int] = {}
     ac_cache: dict[int, list[int]] = {}
-    # rec_ord -> _gt_matrix result (M, ntok, tok1, tok2, tok_over)
-    calls_cache: dict[int, tuple] = {}
+    # per-row plane inputs, filled in the main loop and resolved in one
+    # pass afterwards (native sbn_gt_planes when available)
+    row_rec = np.zeros(n, dtype=np.int32)
+    row_allele = np.zeros(n, dtype=np.int32)
 
     for i, (code, pos, rec_ord, alt_ord, rec) in enumerate(rows):
         alt = rec.alts[alt_ord]
         ref = rec.ref
         if rec_ord not in rec_renumber:
             rec_renumber[rec_ord] = len(rec_renumber)
+            used_records.append(rec)
             ac_cache[rec_ord] = rec.effective_ac()
             an_cache[rec_ord] = rec.effective_an()
         cols["pos"][i] = pos
@@ -318,23 +322,23 @@ def build_index(
         vt_codes[i] = vt_index[rec.vt]
         ref_parts.append(ref.encode())
         alt_parts.append(alt.encode())
-        if gt_bits is not None and rec.genotypes:
-            if rec_ord not in calls_cache:
-                calls_cache[rec_ord] = _gt_matrix(
-                    rec.genotypes, gt_words
-                )
-            allele = alt_ord + 1
-            M, ntok, tok1, tok2, tok_over = calls_cache[rec_ord]
-            copies = (M == allele).sum(axis=1).astype(np.int32)
-            gt_bits[i] = _pack_bits(copies >= 1, gt_words)
-            gt_bits2[i] = _pack_bits(copies >= 2, gt_words)
-            for s_idx in np.nonzero(copies > 2)[0]:
-                # ploidy > 2: keep the exact count
-                gt_overflow.append((i, int(s_idx), int(copies[s_idx])))
-            tok_bits1[i] = tok1
-            tok_bits2[i] = tok2
-            for s_idx, t in tok_over:
-                tok_overflow.append((i, s_idx, t))
+        row_rec[i] = rec_renumber[rec_ord]
+        row_allele[i] = alt_ord + 1
+
+    if gt_bits is not None and n:
+        _fill_gt_planes(
+            used_records,
+            n_samples,
+            gt_words,
+            row_rec,
+            row_allele,
+            gt_bits,
+            gt_bits2,
+            tok_bits1,
+            tok_bits2,
+            gt_overflow,
+            tok_overflow,
+        )
 
     # chrom offsets: chrom_offsets[c] = first row of code c
     codes = np.array([r[0] for r in rows], dtype=np.int32)
@@ -394,7 +398,92 @@ def build_index(
 
 # GT tokenization is shared with the oracle path (genomics/vcf._calls_for,
 # the reference's get_all_calls regex semantics) so the plane builder and
-# the CPU oracle can never drift apart on genotype spellings.
+# the CPU oracle can never drift apart on genotype spellings. The native
+# digit-run scan in gt_planes.cpp implements the same semantics.
+
+
+def _fill_gt_planes(
+    used_records,
+    n_samples: int,
+    gt_words: int,
+    row_rec: np.ndarray,
+    row_allele: np.ndarray,
+    gt_bits: np.ndarray,
+    gt_bits2: np.ndarray,
+    tok_bits1: np.ndarray,
+    tok_bits2: np.ndarray,
+    gt_overflow: list,
+    tok_overflow: list,
+) -> None:
+    """Resolve the genotype planes for all rows — native single pass when
+    the C++ library is available, vectorised Python otherwise.
+
+    Genotype columns are normalised to exactly n_samples entries (extra
+    entries dropped, missing padded empty) identically on both paths, so
+    index contents never depend on whether the native library is built.
+    """
+    from .. import native
+
+    if not any(rec.genotypes for rec in used_records):
+        return  # all-zero planes; skip the whole pass
+
+    def norm_gts(rec) -> list[str]:
+        gts = list(rec.genotypes[:n_samples]) if rec.genotypes else []
+        return gts + [""] * (n_samples - len(gts))
+
+    if native.available():
+        parts: list[bytes] = []
+        offs = np.zeros(len(used_records) * n_samples + 1, dtype=np.uint64)
+        k = 0
+        total = 0
+        for rec in used_records:
+            for gt in norm_gts(rec):
+                b = gt.encode()
+                parts.append(b)
+                total += len(b)
+                k += 1
+                offs[k] = total
+        try:
+            g1, g2, t1, t2, g_over, t_over = native.gt_planes(
+                b"".join(parts),
+                offs,
+                len(used_records),
+                n_samples,
+                row_rec,
+                row_allele,
+                gt_words,
+            )
+        except native.NativeUnavailable:
+            pass
+        else:
+            gt_bits[:] = g1
+            gt_bits2[:] = g2
+            tok_bits1[:] = t1
+            tok_bits2[:] = t2
+            gt_overflow.extend(map(tuple, g_over.tolist()))
+            tok_overflow.extend(map(tuple, t_over.tolist()))
+            return
+
+    calls_cache: dict[int, tuple] = {}
+    for i in range(len(row_rec)):
+        rid = int(row_rec[i])
+        rec = used_records[rid]
+        if not rec.genotypes:
+            continue
+        if rid not in calls_cache:
+            calls_cache[rid] = _gt_matrix(norm_gts(rec), gt_words)
+        M, ntok, tok1, tok2, tok_over = calls_cache[rid]
+        allele = int(row_allele[i])
+        copies = (M == allele).sum(axis=1).astype(np.int32)
+        gt_bits[i] = _pack_bits(copies >= 1, gt_words)
+        gt_bits2[i] = _pack_bits(copies >= 2, gt_words)
+        for s_idx in np.nonzero(copies > 2)[0]:
+            # ploidy > 2: keep the exact count
+            gt_overflow.append((i, int(s_idx), int(copies[s_idx])))
+        tok_bits1[i] = tok1
+        tok_bits2[i] = tok2
+        for s_idx, t in tok_over:
+            tok_overflow.append((i, s_idx, t))
 
 
 def _pack_bits(mask: np.ndarray, words: int) -> np.ndarray:

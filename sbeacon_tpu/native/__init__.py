@@ -24,7 +24,7 @@ log = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "src"
 _LIB_PATH = Path(__file__).parent / "_sbnative.so"
-_SOURCES = ["bgzf.cpp", "scan.cpp", "index_codec.cpp"]
+_SOURCES = ["bgzf.cpp", "scan.cpp", "index_codec.cpp", "gt_planes.cpp"]
 
 _lock = threading.Lock()
 _lib = None
@@ -140,6 +140,28 @@ def get_lib():
             ctypes.c_uint64,
         ]
         lib.sbn_unpack_seq.restype = ctypes.c_int64
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        i64p = ctypes.POINTER(ctypes.c_int64)
+        lib.sbn_gt_planes.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            i32p,
+            i32p,
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            u32p,
+            u32p,
+            u32p,
+            u32p,
+            ctypes.POINTER(i64p),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(i64p),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sbn_gt_planes.restype = ctypes.c_int64
         _lib = lib
         return _lib
 
@@ -337,6 +359,79 @@ def unpack_seq(packed: bytes) -> bytes | None:
     if n < 0:
         raise NativeUnavailable(f"sbn_unpack_seq failed rc={n}")
     return bytes(out[:n])
+
+
+def gt_planes(
+    gt_blob: bytes,
+    gt_off,
+    n_rec: int,
+    n_samples: int,
+    row_rec,
+    row_allele,
+    words: int,
+):
+    """(gt1, gt2, tok1, tok2, gt_overflow, tok_overflow) — the genotype
+    bit planes for all index rows in one native pass (the per-(row,
+    sample) hot loop of build_index). Arrays are uint32[n_rows, words];
+    overflows are int64[k, 3] (row, sample, exact value)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    gt_off = np.ascontiguousarray(gt_off, dtype=np.uint64)
+    row_rec = np.ascontiguousarray(row_rec, dtype=np.int32)
+    row_allele = np.ascontiguousarray(row_allele, dtype=np.int32)
+    n_rows = len(row_rec)
+    planes = [
+        np.zeros((n_rows, words), dtype=np.uint32) for _ in range(4)
+    ]
+    # zero-copy: the C side only reads the blob; keep the bytes object
+    # referenced (blob_view) for the duration of the call
+    blob_view = np.frombuffer(gt_blob or b"\0", dtype=np.uint8)
+    u32 = ctypes.POINTER(ctypes.c_uint32)
+    u64 = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    gt_over_p = i64p()
+    tok_over_p = i64p()
+    n_gt = ctypes.c_uint64()
+    n_tok = ctypes.c_uint64()
+    rc = lib.sbn_gt_planes(
+        blob_view.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        gt_off.ctypes.data_as(u64),
+        n_rec,
+        n_samples,
+        row_rec.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        row_allele.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n_rows,
+        words,
+        *[p.ctypes.data_as(u32) for p in planes],
+        ctypes.byref(gt_over_p),
+        ctypes.byref(n_gt),
+        ctypes.byref(tok_over_p),
+        ctypes.byref(n_tok),
+    )
+    if rc < 0:
+        raise NativeUnavailable(f"sbn_gt_planes failed rc={rc}")
+    try:
+        gt_over = (
+            np.ctypeslib.as_array(gt_over_p, shape=(int(n_gt.value), 3))
+            .copy()
+            .astype(np.int64)
+            if n_gt.value
+            else np.zeros((0, 3), np.int64)
+        )
+        tok_over = (
+            np.ctypeslib.as_array(tok_over_p, shape=(int(n_tok.value), 3))
+            .copy()
+            .astype(np.int64)
+            if n_tok.value
+            else np.zeros((0, 3), np.int64)
+        )
+    finally:
+        lib.sbn_free(ctypes.cast(gt_over_p, ctypes.POINTER(ctypes.c_uint8)))
+        lib.sbn_free(ctypes.cast(tok_over_p, ctypes.POINTER(ctypes.c_uint8)))
+    return planes[0], planes[1], planes[2], planes[3], gt_over, tok_over
 
 
 def count_slice(text: bytes) -> tuple[int, int, int]:

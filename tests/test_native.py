@@ -109,3 +109,79 @@ def test_reader_uses_native_when_preferred(vcf, monkeypatch):
     monkeypatch.setattr(native, "inflate_range", spy)
     BgzfReader(path).read_all()
     assert called
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_gt_planes_native_matches_python():
+    """Native sbn_gt_planes vs the vectorised Python fallback on a corpus
+    with multiallelics, polyploids, missing and malformed genotypes."""
+    import random
+
+    import numpy as np
+
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.testing import random_records
+
+    rng = random.Random(55)
+    recs = random_records(
+        rng, chrom="4", n=300, n_samples=9,
+        p_no_acan=0.5, p_multiallelic=0.4, p_symbolic=0.1,
+    )
+    # sprinkle polyploid / odd genotypes
+    for r in recs[::7]:
+        if r.genotypes:
+            r.genotypes[0] = "1/1/1"
+            r.genotypes[-1] = "."
+    names = [f"S{i}" for i in range(9)]
+
+    native_shard = build_index(
+        recs, dataset_id="n", vcf_location="v", sample_names=names
+    )
+    orig = native.available
+    native.available = lambda: False  # force the Python fallback
+    try:
+        py_shard = build_index(
+            recs, dataset_id="n", vcf_location="v", sample_names=names
+        )
+    finally:
+        native.available = orig
+
+    for attr in ("gt_bits", "gt_bits2", "tok_bits1", "tok_bits2"):
+        np.testing.assert_array_equal(
+            getattr(native_shard, attr), getattr(py_shard, attr), attr
+        )
+    for attr in ("gt_overflow", "tok_overflow"):
+        a = sorted(map(tuple, getattr(native_shard, attr).tolist()))
+        b = sorted(map(tuple, getattr(py_shard, attr).tolist()))
+        assert a == b, attr
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_gt_planes_extra_genotypes_normalised():
+    """More GT entries than sample_names: both paths truncate identically
+    (index contents must not depend on the native lib being built)."""
+    import numpy as np
+
+    from sbeacon_tpu.genomics.vcf import VcfRecord
+    from sbeacon_tpu.index.columnar import build_index
+
+    recs = [
+        VcfRecord(
+            chrom="1", pos=100, ref="A", alts=["T"], ac=None, an=None,
+            vt="SNP", genotypes=["0|1", "1|1", "0|0", "1|0"],  # 4 GTs
+        )
+    ]
+    names = ["S0", "S1"]  # only 2 sample names
+    a = build_index(recs, dataset_id="x", vcf_location="v", sample_names=names)
+    orig = native.available
+    native.available = lambda: False
+    try:
+        b = build_index(
+            recs, dataset_id="x", vcf_location="v", sample_names=names
+        )
+    finally:
+        native.available = orig
+    np.testing.assert_array_equal(a.gt_bits, b.gt_bits)
+    np.testing.assert_array_equal(a.tok_bits1, b.tok_bits1)
+    # only the first 2 samples' bits are ever set
+    assert int(a.gt_bits[0, 0]) & ~0b11 == 0
