@@ -361,6 +361,11 @@ class VariantEngine:
             dindex = None
         self._indexes[key] = (shard, dindex)
 
+    def close(self) -> None:
+        """Release the scatter pool (same contract as
+        DistributedEngine.close)."""
+        self._scatter.shutdown(wait=False, cancel_futures=True)
+
     def datasets(self) -> list[str]:
         return sorted({ds for ds, _ in self._indexes})
 

@@ -182,6 +182,15 @@ class DistributedEngine:
         self._routes_lock = threading.Lock()
         self._routes: dict[str, str] | None = None  # dataset -> worker url
         self._fingerprints: dict[str, str] = {}
+        # persistent scatter pool (no per-search thread churn)
+        self._pool = ThreadPoolExecutor(
+            max_workers=max_threads, thread_name_prefix="dispatch"
+        )
+
+    def close(self) -> None:
+        """Release the scatter pool (engines are long-lived; call this
+        when rebuilding one on config/route changes)."""
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     # -- discovery ----------------------------------------------------------
 
@@ -287,13 +296,22 @@ class DistributedEngine:
                 )
             responses: list[VariantSearchResponse] = []
             if tasks:
-                with ThreadPoolExecutor(
-                    min(self.max_threads, len(tasks))
-                ) as pool:
-                    for result in pool.map(
-                        lambda t: self._call_worker(*t), tasks
-                    ):
-                        responses.extend(result)
+                # await every future before raising: a fast-failing
+                # worker must not strand slow siblings' tasks in the
+                # shared pool (they'd hold threads for up to timeout_s
+                # and starve concurrent searches)
+                futures = [
+                    self._pool.submit(self._call_worker, *t) for t in tasks
+                ]
+                first_err: Exception | None = None
+                for f in futures:
+                    try:
+                        responses.extend(f.result())
+                    except Exception as e:
+                        if first_err is None:
+                            first_err = e
+                if first_err is not None:
+                    raise first_err
             if local_wanted:
                 responses.extend(
                     self.local.search(

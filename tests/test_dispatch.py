@@ -208,3 +208,47 @@ def test_app_ingest_targets_local_engine(tmp_path, cluster):
     assert set(dist.datasets()) >= {"dsA", "dsB", "dsLocal"}
     got = dist.search(PAYLOAD)
     assert {r.dataset_id for r in got} == {"dsA", "dsB", "dsLocal"}
+
+
+def test_fast_failure_awaits_slow_siblings():
+    """A fast-failing worker must not strand slow siblings' tasks in the
+    shared pool: search() awaits every future before raising."""
+    import threading
+    import time
+
+    done = threading.Event()
+
+    def post(url, doc, timeout_s):
+        if "fast" in url:
+            raise OSError("down")
+        time.sleep(0.2)  # slow sibling
+        done.set()
+        return 200, {"responses": []}
+
+    def get(url, timeout_s):
+        ds = "dsF" if "fast" in url else "dsS"
+        return 200, {"datasets": [ds], "fingerprint": ds}
+
+    dist = DistributedEngine(
+        ["http://fast:1", "http://slow:1"], retries=0, post=post, get=get
+    )
+    import dataclasses
+
+    t0 = time.time()
+    with pytest.raises(WorkerError):
+        dist.search(
+            dataclasses.replace(PAYLOAD, dataset_ids=["dsF", "dsS"])
+        )
+    # the raise happened only after the slow sibling finished
+    assert done.is_set()
+    assert time.time() - t0 >= 0.2
+    dist.close()
+
+
+def test_engine_close_releases_pools(cluster):
+    w1, _ = cluster
+    dist = DistributedEngine([w1.address])
+    dist.search(PAYLOAD)
+    dist.close()
+    eng = _engine("dsZ", seed0=400)
+    eng.close()
