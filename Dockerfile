@@ -1,0 +1,38 @@
+# Deployment container for the TPU-native Beacon (the reference's
+# docker/Dockerfile + init.sh toolchain role, SURVEY.md L8 — except this
+# build needs no AWS SDK, lambda runtime, or htslib/bcftools: the
+# framework carries its own BGZF/VCF machinery and the only native
+# dependency is zlib, compiled on first use via g++).
+#
+#   docker build -t sbeacon-tpu .
+#   docker run -p 5000:5000 -v /data:/data sbeacon-tpu \
+#       --data-root /data [--worker http://worker1:5100 ...]
+#
+# Worker hosts run the same image with a different entrypoint:
+#   docker run -p 5100:5100 -v /data:/data --entrypoint \
+#       python sbeacon-tpu -m sbeacon_tpu.parallel.dispatch \
+#       --data-root /data --port 5100
+#
+# On TPU VMs, base this on the matching libtpu image instead and jax
+# picks the chips up automatically; CPU serving works as-is.
+
+FROM python:3.12-slim
+
+RUN apt-get update \
+    && apt-get install -y --no-install-recommends g++ zlib1g-dev \
+    && rm -rf /var/lib/apt/lists/*
+
+RUN pip install --no-cache-dir \
+    "jax[cpu]" numpy jsonschema cryptography
+
+WORKDIR /app
+COPY sbeacon_tpu ./sbeacon_tpu
+
+# pre-build the native library so first-request latency stays flat;
+# force=True so a host-built .so that slipped past .dockerignore can
+# never shadow a compile for THIS image's toolchain
+RUN python -c "from sbeacon_tpu import native; native.build(force=True)"
+
+EXPOSE 5000
+ENTRYPOINT ["python", "-m", "sbeacon_tpu.api.server"]
+CMD ["--port", "5000"]

@@ -28,6 +28,7 @@ import logging
 import threading
 import time
 import urllib.request
+import concurrent.futures as futures_mod
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -192,6 +193,12 @@ class DistributedEngine:
         when rebuilding one on config/route changes)."""
         self._pool.shutdown(wait=False, cancel_futures=True)
 
+    def __enter__(self) -> "DistributedEngine":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
     # -- discovery ----------------------------------------------------------
 
     def _discover(self) -> dict[str, str]:
@@ -303,11 +310,13 @@ class DistributedEngine:
                 futures = [
                     self._pool.submit(self._call_worker, *t) for t in tasks
                 ]
-                first_err: Exception | None = None
+                first_err: BaseException | None = None
                 for f in futures:
                     try:
                         responses.extend(f.result())
-                    except Exception as e:
+                    except (Exception, futures_mod.CancelledError) as e:
+                        # CancelledError (close() mid-search) is a
+                        # BaseException: it must not abort the drain
                         if first_err is None:
                             first_err = e
                 if first_err is not None:
