@@ -124,8 +124,24 @@ def pack_records_py(pos, refs, alts, *, level: int = 9) -> bytes:
 def unpack_records_py(
     blob: bytes, range_start: int = 0, range_end: int = 2**63 - 1
 ):
-    """Pure-Python decoder: (pos uint64 ndarray, payload list[bytes])."""
-    raw = zlib.decompress(blob, 15 + 32)
+    """Pure-Python decoder: (pos uint64 ndarray, payload list[bytes]).
+
+    Inflates every concatenated gzip member: the reference writer emits
+    multiple back-to-back members in one region object when its 50 MB raw
+    ceiling is hit (write_data_to_s3.h saveOutputToS3:39-92), so a single
+    ``zlib.decompress`` call would silently drop all records after the
+    first member.
+    """
+    chunks = []
+    rest = blob
+    while rest:
+        do = zlib.decompressobj(15 + 32)
+        chunks.append(do.decompress(rest))
+        chunks.append(do.flush())
+        if not do.eof:
+            raise ValueError("truncated gzip member")
+        rest = do.unused_data
+    raw = b"".join(chunks)
     positions, payloads = [], []
     i, n = 0, len(raw)
     while i + 10 <= n:

@@ -107,25 +107,36 @@ bool GzipCompress(const std::string& in, int level, std::string* out) {
   return true;
 }
 
+// Inflates ALL concatenated gzip/zlib members in [in, in+in_len).  The
+// reference region writer deflates repeatedly into one object whenever the
+// 50 MB raw ceiling is hit (write_data_to_s3.h saveOutputToS3), so a single
+// region blob may hold several back-to-back gzip members; stopping at the
+// first Z_STREAM_END would silently drop every record after it.
 bool GzipDecompress(const uint8_t* in, size_t in_len, std::string* out) {
-  z_stream zs{};
-  if (inflateInit2(&zs, 15 + 32) != Z_OK) return false;  // gzip or zlib
-  zs.next_in = const_cast<Bytef*>(in);
-  zs.avail_in = in_len;
   out->clear();
+  const Bytef* next = const_cast<Bytef*>(in);
+  size_t remaining = in_len;
   char buf[1 << 16];
-  int rc;
-  do {
-    zs.next_out = reinterpret_cast<Bytef*>(buf);
-    zs.avail_out = sizeof(buf);
-    rc = inflate(&zs, Z_NO_FLUSH);
-    if (rc != Z_OK && rc != Z_STREAM_END) {
-      inflateEnd(&zs);
-      return false;
-    }
-    out->append(buf, sizeof(buf) - zs.avail_out);
-  } while (rc != Z_STREAM_END);
-  inflateEnd(&zs);
+  while (remaining > 0) {
+    z_stream zs{};
+    if (inflateInit2(&zs, 15 + 32) != Z_OK) return false;  // gzip or zlib
+    zs.next_in = const_cast<Bytef*>(next);
+    zs.avail_in = remaining;
+    int rc;
+    do {
+      zs.next_out = reinterpret_cast<Bytef*>(buf);
+      zs.avail_out = sizeof(buf);
+      rc = inflate(&zs, Z_NO_FLUSH);
+      if (rc != Z_OK && rc != Z_STREAM_END) {
+        inflateEnd(&zs);
+        return false;  // corrupt member or trailing garbage: error loudly
+      }
+      out->append(buf, sizeof(buf) - zs.avail_out);
+    } while (rc != Z_STREAM_END);
+    next = zs.next_in;
+    remaining = zs.avail_in;
+    inflateEnd(&zs);
+  }
   return true;
 }
 

@@ -229,3 +229,36 @@ def test_distinct_count_files_matches_shard_dedupe(tmp_path):
         }
     )
     assert got == expected
+
+
+def test_multi_member_gzip_blob_both_paths():
+    """A region blob made of concatenated gzip members must decode fully.
+
+    The reference writer deflates repeatedly into one object when its 50 MB
+    raw ceiling is hit (write_data_to_s3.h saveOutputToS3:39-92), producing
+    several back-to-back gzip members; decoders that stop at the first
+    Z_STREAM_END silently drop everything after it.
+    """
+    blob_a = pt.pack_records_py([100, 200], [b"A", b"C"], [b"T", b"G"])
+    blob_b = pt.pack_records_py([300], [b"AC"], [b"T"])
+    blob_c = pt.pack_records_py([400, 500], [b"G", b"T"], [b"GA", b"C"])
+    combined = blob_a + blob_b + blob_c
+    for decode in (pt.unpack_records_py, pt.unpack_records) + (
+        (native.unpack_records,) if native.available() else ()
+    ):
+        pos, payloads = decode(combined)
+        assert list(np.asarray(pos, dtype=np.int64)) == [100, 200, 300, 400, 500]
+        assert len(payloads) == 5
+    # range filter spans member boundaries too
+    pos, payloads = pt.unpack_records_py(combined, 200, 400)
+    assert list(np.asarray(pos, dtype=np.int64)) == [200, 300, 400]
+
+
+def test_truncated_trailing_member_raises():
+    blob = pt.pack_records_py([100], [b"A"], [b"T"])
+    bad = blob + b"\x1f\x8b\x08\x00garbage"
+    with pytest.raises(Exception):
+        pt.unpack_records_py(bad)
+    if native.available():
+        with pytest.raises(Exception):
+            native.unpack_records(bad)
