@@ -129,29 +129,50 @@ class MicroBatcher:
         return me.result
 
     def _lead(self, acc: _Accumulator, dindex, window_cap, record_cap):
-        # wait for followers: either the batch fills or the window lapses
-        sleeper = threading.Event()  # timed wait without busy-looping
-        waited = 0.0
-        step = self.max_wait_s / 4 if self.max_wait_s > 0 else 0
-        while waited < self.max_wait_s:
-            with acc.lock:
-                if len(acc.items) >= self.max_batch:
-                    break
-            sleeper.wait(step)
-            waited += step
+        # The whole leader body runs under try/finally: if the leader dies
+        # with anything _execute doesn't swallow (e.g. KeyboardInterrupt in
+        # the follower-wait window), leadership must not stay claimed —
+        # queued followers wait on event.wait() with no timeout, so a
+        # leaked leader_active=True would hang them and every future
+        # submit to this accumulator.
+        batch: list[_Pending] = []
+        try:
+            # wait for followers: batch fills or the window lapses
+            sleeper = threading.Event()  # timed wait without busy-looping
+            waited = 0.0
+            step = self.max_wait_s / 4 if self.max_wait_s > 0 else 0
+            while waited < self.max_wait_s:
+                with acc.lock:
+                    if len(acc.items) >= self.max_batch:
+                        break
+                sleeper.wait(step)
+                waited += step
 
-        while True:
-            with acc.lock:
-                batch = acc.items[: self.max_batch]
-                acc.items = acc.items[self.max_batch :]
-                more = bool(acc.items)
+            while True:
+                with acc.lock:
+                    batch = acc.items[: self.max_batch]
+                    acc.items = acc.items[self.max_batch :]
+                    more = bool(acc.items)
+                    if not more:
+                        acc.leader_active = False
+                if not batch:
+                    return
+                self._execute(batch, dindex, window_cap, record_cap)
                 if not more:
-                    acc.leader_active = False
-            if not batch:
-                return
-            self._execute(batch, dindex, window_cap, record_cap)
-            if not more:
-                return
+                    return
+        except BaseException as e:
+            with acc.lock:
+                acc.leader_active = False
+                orphans, acc.items = acc.items, []
+            # fail both the still-queued items AND the already-dequeued
+            # batch: an exception escaping between the pop and _execute's
+            # per-item event.set() would otherwise strand batch followers
+            # on event.wait() forever
+            for p in orphans + batch:
+                if not p.event.is_set():
+                    p.error = e
+                    p.event.set()
+            raise
 
     def _execute(self, batch, dindex, window_cap, record_cap):
         specs = [p.spec for p in batch]

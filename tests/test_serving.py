@@ -150,3 +150,36 @@ def test_engine_batched_equals_unbatched():
     r_off = off.search(pay)
     assert len(r_on) == len(r_off) == 1
     assert r_on[0].dumps() == r_off[0].dumps()
+
+
+def test_leader_death_releases_leadership_and_fails_followers(dindex):
+    """If the leader dies with an exception _execute doesn't swallow
+    (e.g. KeyboardInterrupt in the follower-wait window), leadership must
+    be released and queued followers unblocked with the error — otherwise
+    they (and every future submit) hang on event.wait() forever."""
+    shard, di = dindex
+    (spec,) = specs_for(shard, 1)
+    mb = MicroBatcher(max_batch=64, max_wait_ms=0)
+
+    class Boom(BaseException):
+        pass
+
+    orig = MicroBatcher._execute
+
+    def exploding(self, batch, dindex_, window_cap, record_cap):
+        raise Boom("leader died")
+
+    MicroBatcher._execute = exploding
+    try:
+        with pytest.raises(Boom):
+            mb.submit(di, spec, window_cap=256, record_cap=64)
+    finally:
+        MicroBatcher._execute = orig
+
+    acc = mb._accum(di, (256, 64))
+    assert acc.leader_active is False
+    assert acc.items == []
+    # accumulator is healthy again: a fresh submit leads and completes
+    got = mb.submit(di, spec, window_cap=256, record_cap=64)
+    ref = run_queries(di, [spec], window_cap=256, record_cap=64)
+    assert got.exists[0] == ref.exists[0]
