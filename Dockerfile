@@ -8,10 +8,14 @@
 #   docker run -p 5000:5000 -v /data:/data sbeacon-tpu \
 #       --data-root /data [--worker http://worker1:5100 ...]
 #
-# Worker hosts run the same image with a different entrypoint:
-#   docker run -p 5100:5100 -v /data:/data --entrypoint \
-#       python sbeacon-tpu -m sbeacon_tpu.parallel.dispatch \
-#       --data-root /data --port 5100
+# Worker hosts run the same image with a different entrypoint. Workers
+# serve all genomic data, so keep them on a private network AND set a
+# shared BEACON_WORKER_TOKEN (required on /search and /datasets; the
+# coordinator sends it automatically). --host must be widened
+# explicitly — the worker CLI binds loopback by default:
+#   docker run -p 5100:5100 -v /data:/data -e BEACON_WORKER_TOKEN=... \
+#       --entrypoint python sbeacon-tpu -m sbeacon_tpu.parallel.dispatch \
+#       --data-root /data --port 5100 --host 0.0.0.0
 #
 # On TPU VMs, base this on the matching libtpu image instead and jax
 # picks the chips up automatically; CPU serving works as-is.

@@ -18,6 +18,7 @@ tests, batch drivers) is external.
 
 from __future__ import annotations
 
+import hmac
 from pathlib import Path
 
 from ..config import BeaconConfig, StorageConfig
@@ -80,6 +81,33 @@ def strip_private(doc: dict) -> dict:
     return {k: v for k, v in doc.items() if not k.startswith("_")}
 
 
+def _authorization_header(headers: dict) -> str:
+    for k, v in (headers or {}).items():
+        if k.lower() == "authorization":
+            return v
+    return ""
+
+
+def bearer_token_verifier(token: str):
+    """Default auth hook: require ``Authorization: Bearer <token>``.
+
+    Returns a verifier ``(method, path, headers) -> (authorized, reason)``.
+    The reference gates ``/submit`` with an AWS_IAM authorizer (reference:
+    api.tf:120-149); deployments needing real identity (OIDC, mTLS) pass
+    their own callable as ``BeaconApp(auth_verifier=...)``.
+    """
+
+    def verify(method: str, path: str, headers: dict) -> tuple[bool, str]:
+        got = _authorization_header(headers)
+        # constant-time compare: == short-circuits on the first differing
+        # byte, leaking token-prefix length via response timing
+        if not hmac.compare_digest(got, f"Bearer {token}"):
+            return False, "invalid token"
+        return True, ""
+
+    return verify
+
+
 class BeaconApp:
     def __init__(
         self,
@@ -89,6 +117,7 @@ class BeaconApp:
         ontology: OntologyStore | None = None,
         engine: VariantEngine | None = None,
         ingest: IngestService | None = None,
+        auth_verifier=None,
     ):
         if config is None:
             # configless (ad hoc / test) apps keep sqlite in memory and
@@ -147,6 +176,16 @@ class BeaconApp:
             inline_limit=self.config.engine.max_response_inline_bytes,
         )
         self.query_runner = AsyncQueryRunner(self.engine, self.query_jobs)
+        # mutating-route auth (reference /submit is AWS_IAM-gated,
+        # api.tf:120-149): explicit verifier > config token > open (dev)
+        if auth_verifier is not None:
+            self.auth_verifier = auth_verifier
+        elif self.config.auth.submit_token:
+            self.auth_verifier = bearer_token_verifier(
+                self.config.auth.submit_token
+            )
+        else:
+            self.auth_verifier = None
 
     # -- transport-facing entry --------------------------------------------
 
@@ -156,14 +195,38 @@ class BeaconApp:
         path: str,
         query_params: dict | None = None,
         body: dict | None = None,
+        headers: dict | None = None,
     ) -> tuple[int, dict]:
         try:
             with span("api.handle", path=path, method=method):
+                denied = self._check_auth(method.upper(), path, headers)
+                if denied is not None:
+                    return denied
                 return self._route(method.upper(), path, query_params, body)
         except (RequestError, FilterError, VcfLocationError) as e:
             return 400, self.env.error(400, str(e))
         except Exception as e:  # pragma: no cover - defensive 500
             return 500, self.env.error(500, f"{type(e).__name__}: {e}")
+
+    def _check_auth(self, method, path, headers) -> tuple[int, dict] | None:
+        """401/403 envelope for unauthorized mutating requests, else None.
+
+        Only mutating routes (``/submit`` POST/PATCH) are gated — read
+        routes stay public, matching the reference API where only the
+        submit resource carries the AWS_IAM authorizer. Standard HTTP
+        semantics decide the status structurally: no credential presented
+        (no Authorization header) -> 401; credential presented but
+        rejected by the verifier -> 403."""
+        if self.auth_verifier is None:
+            return None
+        if path.strip("/") != "submit" or method not in ("POST", "PATCH"):
+            return None
+        ok, reason = self.auth_verifier(method, path, headers or {})
+        if ok:
+            return None
+        if not _authorization_header(headers or {}):
+            return 401, self.env.error(401, "missing Authorization header")
+        return 403, self.env.error(403, reason or "forbidden")
 
     # -- routing ------------------------------------------------------------
 

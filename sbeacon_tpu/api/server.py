@@ -40,7 +40,8 @@ def _make_handler(app: BeaconApp):
                     self._send(400, {"error": "invalid JSON body"})
                     return
             status, payload = app.handle(
-                self.command, parsed.path, query, body
+                self.command, parsed.path, query, body,
+                headers=dict(self.headers.items()),
             )
             self._send(status, payload)
 
@@ -59,7 +60,9 @@ def _make_handler(app: BeaconApp):
             self.send_header(
                 "Access-Control-Allow-Methods", "GET, POST, PATCH, OPTIONS"
             )
-            self.send_header("Access-Control-Allow-Headers", "Content-Type")
+            self.send_header(
+                "Access-Control-Allow-Headers", "Content-Type, Authorization"
+            )
             self.send_header("Content-Length", "0")
             self.end_headers()
 

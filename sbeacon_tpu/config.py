@@ -158,6 +158,25 @@ class ResolverConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class AuthConfig:
+    """Authentication for the two trust boundaries the reference gates
+    with IAM: the mutating ``/submit`` route (reference: api.tf:120-149,
+    AWS_IAM authorizer) and the worker-invoke boundary (reference: direct
+    Lambda invoke / SNS, IAM-authenticated).
+
+    Empty token = open (dev mode, matches round-1 behavior). Set
+    ``submit_token`` to require ``Authorization: Bearer <token>`` on
+    POST/PATCH ``/submit``; set ``worker_token`` to require the same on
+    every coordinator->worker HTTP call (except ``/health``). Workers
+    should additionally only be reachable on a private network — the
+    token is defense-in-depth, not a substitute for network isolation.
+    """
+
+    submit_token: str = ""
+    worker_token: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class BeaconConfig:
     info: BeaconInfo = dataclasses.field(default_factory=BeaconInfo)
     storage: StorageConfig = dataclasses.field(default_factory=StorageConfig)
@@ -166,6 +185,7 @@ class BeaconConfig:
     resolvers: ResolverConfig = dataclasses.field(
         default_factory=ResolverConfig
     )
+    auth: AuthConfig = dataclasses.field(default_factory=AuthConfig)
 
     @staticmethod
     def from_env(root: str | os.PathLike | None = None) -> "BeaconConfig":
@@ -203,8 +223,16 @@ class BeaconConfig:
             ),
             workers=int(env.get("BEACON_RESOLVER_WORKERS", "8")),
         )
+        auth = AuthConfig(
+            submit_token=env.get("BEACON_SUBMIT_TOKEN", ""),
+            worker_token=env.get("BEACON_WORKER_TOKEN", ""),
+        )
         return BeaconConfig(
-            info=info, storage=storage, engine=engine, resolvers=resolvers
+            info=info,
+            storage=storage,
+            engine=engine,
+            resolvers=resolvers,
+            auth=auth,
         )
 
     def dumps(self) -> str:

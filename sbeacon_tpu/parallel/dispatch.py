@@ -23,10 +23,12 @@ multi-host *compute* (one jit program spanning hosts), see
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import threading
 import time
+import urllib.error
 import urllib.request
 import concurrent.futures as futures_mod
 from concurrent.futures import ThreadPoolExecutor
@@ -41,7 +43,7 @@ log = logging.getLogger(__name__)
 # -- worker side --------------------------------------------------------------
 
 
-def _make_handler(engine):
+def _make_handler(engine, token: str = ""):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -54,9 +56,20 @@ def _make_handler(engine):
             self.end_headers()
             self.wfile.write(body)
 
+        def _authorized(self) -> bool:
+            # shared-token gate on the worker boundary (the reference's
+            # equivalent — direct Lambda invoke/SNS — was IAM-gated);
+            # /health stays open for liveness probes
+            if not token:
+                return True
+            got = self.headers.get("Authorization", "")
+            return hmac.compare_digest(got, f"Bearer {token}")
+
         def do_GET(self):
             if self.path == "/health":
                 self._send(200, {"ok": True})
+            elif not self._authorized():
+                self._send(401, {"error": "unauthorized"})
             elif self.path == "/datasets":
                 self._send(
                     200,
@@ -69,6 +82,9 @@ def _make_handler(engine):
                 self._send(404, {"error": "not found"})
 
         def do_POST(self):
+            if not self._authorized():
+                self._send(401, {"error": "unauthorized"})
+                return
             if self.path != "/search":
                 self._send(404, {"error": "not found"})
                 return
@@ -93,10 +109,17 @@ class WorkerServer:
     """One worker host's engine behind HTTP (the performQuery leaf's
     process boundary, minus SNS)."""
 
-    def __init__(self, engine, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        engine,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        *,
+        token: str = "",
+    ):
         self.engine = engine
         self.server = ThreadingHTTPServer(
-            (host, port), _make_handler(engine)
+            (host, port), _make_handler(engine, token)
         )
         self.thread: threading.Thread | None = None
 
@@ -120,11 +143,13 @@ class WorkerServer:
 # -- coordinator side ---------------------------------------------------------
 
 
-def urllib_post(url: str, doc: dict, timeout_s: float) -> tuple[int, dict]:
+def urllib_post(
+    url: str, doc: dict, timeout_s: float, headers: dict | None = None
+) -> tuple[int, dict]:
     req = urllib.request.Request(
         url,
         data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json"},
+        headers={"Content-Type": "application/json", **(headers or {})},
         method="POST",
     )
     try:
@@ -137,8 +162,11 @@ def urllib_post(url: str, doc: dict, timeout_s: float) -> tuple[int, dict]:
             return e.code, {"error": str(e)}
 
 
-def urllib_get(url: str, timeout_s: float) -> tuple[int, dict]:
-    with urllib.request.urlopen(url, timeout=timeout_s) as resp:
+def urllib_get(
+    url: str, timeout_s: float, headers: dict | None = None
+) -> tuple[int, dict]:
+    req = urllib.request.Request(url, headers=headers or {})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         return resp.status, json.loads(resp.read())
 
 
@@ -166,6 +194,7 @@ class DistributedEngine:
         max_threads: int = 64,
         post=urllib_post,
         get=urllib_get,
+        token: str = "",
     ):
         from ..config import BeaconConfig
 
@@ -180,6 +209,11 @@ class DistributedEngine:
         self.max_threads = max_threads
         self._post = post
         self._get = get
+        # self.config is always resolved by now (explicit > local's >
+        # default), so the token fallback must read it — reading the raw
+        # `config` param would silently drop a token that arrived via
+        # local.config.auth.worker_token
+        self._token = token or self.config.auth.worker_token
         self._routes_lock = threading.Lock()
         self._routes: dict[str, str] | None = None  # dataset -> worker url
         self._fingerprints: dict[str, str] = {}
@@ -187,6 +221,23 @@ class DistributedEngine:
         self._pool = ThreadPoolExecutor(
             max_workers=max_threads, thread_name_prefix="dispatch"
         )
+
+    # auth header is passed only when a token is configured, so injected
+    # test transports keep their 3-/2-arg signatures
+    def _post_auth(self, url: str, doc: dict, timeout_s: float):
+        if self._token:
+            return self._post(
+                url, doc, timeout_s,
+                {"Authorization": f"Bearer {self._token}"},
+            )
+        return self._post(url, doc, timeout_s)
+
+    def _get_auth(self, url: str, timeout_s: float):
+        if self._token:
+            return self._get(
+                url, timeout_s, {"Authorization": f"Bearer {self._token}"}
+            )
+        return self._get(url, timeout_s)
 
     def close(self) -> None:
         """Release the scatter pool (engines are long-lived; call this
@@ -206,9 +257,31 @@ class DistributedEngine:
         fps: dict[str, str] = {}
         for url in self.worker_urls:
             try:
-                status, doc = self._get(f"{url}/datasets", self.timeout_s)
+                status, doc = self._get_auth(f"{url}/datasets", self.timeout_s)
+            except urllib.error.HTTPError as e:
+                if e.code in (401, 403):
+                    # auth failure must not masquerade as a network
+                    # problem: an operator chasing 'unreachable' would
+                    # debug routing, not the token
+                    log.error(
+                        "worker %s rejected coordinator credentials "
+                        "(http %s): check BEACON_WORKER_TOKEN / --token",
+                        url,
+                        e.code,
+                    )
+                else:
+                    log.warning("worker %s unreachable: %s", url, e)
+                continue
             except Exception as e:
                 log.warning("worker %s unreachable: %s", url, e)
+                continue
+            if status in (401, 403):
+                log.error(
+                    "worker %s rejected coordinator credentials (http %s): "
+                    "check BEACON_WORKER_TOKEN / --token",
+                    url,
+                    status,
+                )
                 continue
             if status != 200:
                 continue
@@ -251,7 +324,7 @@ class DistributedEngine:
         last = None
         for attempt in range(self.retries + 1):
             try:
-                status, out = self._post(
+                status, out = self._post_auth(
                     f"{url}/search", doc, self.timeout_s
                 )
             except Exception as e:
@@ -363,15 +436,25 @@ def main(argv: list[str] | None = None) -> None:
     from ..ingest import IngestService
 
     p = argparse.ArgumentParser(description="beacon query worker host")
-    p.add_argument("--host", default="0.0.0.0")
+    # loopback by default: workers serve all genomic data unauthenticated
+    # unless --token/BEACON_WORKER_TOKEN is set, so exposure beyond the
+    # host must be an explicit choice (--host 0.0.0.0 on a private net)
+    p.add_argument("--host", default="127.0.0.1")
     p.add_argument("--port", type=int, default=5100)
     p.add_argument("--data-root", default=None)
+    p.add_argument(
+        "--token",
+        default=None,
+        help="shared bearer token required on /search and /datasets "
+        "(default: BEACON_WORKER_TOKEN env)",
+    )
     args = p.parse_args(argv)
 
     config = BeaconConfig.from_env(args.data_root)
+    token = args.token if args.token is not None else config.auth.worker_token
     engine = VariantEngine(config)
     n = IngestService(config, engine=engine).load_all()
-    worker = WorkerServer(engine, host=args.host, port=args.port)
+    worker = WorkerServer(engine, host=args.host, port=args.port, token=token)
     print(
         f"worker serving on {args.host}:{args.port} ({n} shards, "
         f"datasets: {', '.join(engine.datasets()) or 'none'})"

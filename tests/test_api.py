@@ -583,3 +583,110 @@ def test_app_rejects_shardless_engine():
 
     with _pytest.raises(ValueError, match="add_index"):
         BeaconApp(engine=QueryOnly())
+
+
+def test_submit_auth_token(tmp_path):
+    """/submit with a configured token: missing header 401, wrong token
+    403, correct token 200; read routes stay public (reference: only the
+    submit resource carries the AWS_IAM authorizer, api.tf:120-149)."""
+    from sbeacon_tpu.config import AuthConfig
+
+    rng = random.Random(11)
+    recs = random_records(rng, chrom="22", n=40, n_samples=len(SAMPLES))
+    vcf = tmp_path / "dsA.vcf.gz"
+    write_vcf(vcf, recs, sample_names=SAMPLES)
+    ensure_index(vcf)
+
+    config = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "data"),
+        auth=AuthConfig(submit_token="hunter2"),
+    )
+    config.storage.ensure()
+    app = BeaconApp(config)
+    sub = _submission("dsA", "cA", vcf, lambda i: SEX_TERMS[i % 2])
+
+    status, body = app.handle("POST", "/submit", body=sub)
+    assert status == 401
+    assert body["error"]["errorCode"] == 401
+
+    status, body = app.handle(
+        "POST", "/submit", body=sub,
+        headers={"Authorization": "Bearer wrong"},
+    )
+    assert status == 403
+
+    status, body = app.handle(
+        "POST", "/submit", body=sub,
+        headers={"Authorization": "Bearer hunter2"},
+    )
+    assert status == 200, body
+
+    # read routes unaffected
+    status, _ = app.handle("GET", "/info")
+    assert status == 200
+    status, _ = app.handle("GET", "/datasets")
+    assert status == 200
+
+    # header casing from real HTTP transports must work end-to-end
+    server, _ = start_background(app)
+    port = server.server_address[1]
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/submit",
+            data=json.dumps(sub).encode(),
+            headers={
+                "Content-Type": "application/json",
+                "authorization": "Bearer hunter2",
+            },
+            method="POST",
+        )
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.status == 200
+        # and a PATCH without the token is denied over HTTP too
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/submit",
+            data=json.dumps({"datasetId": "dsA"}).encode(),
+            headers={"Content-Type": "application/json"},
+            method="PATCH",
+        )
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "expected 401"
+        except urllib.error.HTTPError as e:
+            assert e.code == 401
+    finally:
+        server.shutdown()
+        server.server_close()
+
+
+def test_custom_auth_verifier(tmp_path):
+    """Pluggable verifier replaces the bearer default (OIDC/mTLS hook)."""
+    seen = []
+
+    def verifier(method, path, headers):
+        seen.append((method, path))
+        return headers.get("X-User") == "admin", "not admin"
+
+    config = BeaconConfig(storage=StorageConfig(root=tmp_path / "data"))
+    config.storage.ensure()
+    app = BeaconApp(config, auth_verifier=verifier)
+    # no credential presented at all -> 401 (structural, not verifier-str)
+    status, _ = app.handle(
+        "POST", "/submit", body={"datasetId": "x"},
+        headers={"X-User": "nobody"},
+    )
+    assert status == 401
+    # credential presented but rejected -> 403 with the verifier's reason
+    status, body = app.handle(
+        "POST", "/submit", body={"datasetId": "x"},
+        headers={"X-User": "nobody", "Authorization": "Bearer whatever"},
+    )
+    assert status == 403
+    assert "not admin" in body["error"]["errorMessage"]
+    # authorized request proceeds into normal validation (400, not 403)
+    status, _ = app.handle(
+        "POST", "/submit", body={"datasetId": "x"},
+        headers={"X-User": "admin", "Authorization": "Bearer whatever"},
+    )
+    assert status == 400
+    assert len(seen) == 3

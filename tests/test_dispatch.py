@@ -252,3 +252,47 @@ def test_engine_close_releases_pools(cluster):
     dist.close()
     eng = _engine("dsZ", seed0=400)
     eng.close()
+
+
+def test_worker_token_gates_requests():
+    """Workers with a shared token 401 unauthenticated calls (the
+    reference's worker boundary was IAM-gated, SURVEY.md §2.4); a
+    coordinator configured with the token works end-to-end, and /health
+    stays open for liveness probes."""
+    from sbeacon_tpu.parallel.dispatch import urllib_get, urllib_post
+
+    w = WorkerServer(_engine("dsA"), token="s3cret").start_background()
+    try:
+        status, _ = urllib_get(f"{w.address}/health", 5)
+        assert status == 200
+        import urllib.error
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib_get(f"{w.address}/datasets", 5)
+        assert ei.value.code == 401
+        status, doc = urllib_post(
+            f"{w.address}/search", PAYLOAD.__dict__ | {}, 5
+        )
+        assert status == 401
+
+        status, doc = urllib_get(
+            f"{w.address}/datasets", 5,
+            {"Authorization": "Bearer s3cret"},
+        )
+        assert status == 200 and doc["datasets"] == ["dsA"]
+
+        dist = DistributedEngine([w.address], token="s3cret")
+        try:
+            responses = dist.search(PAYLOAD)
+            assert {r.dataset_id for r in responses} == {"dsA"}
+        finally:
+            dist.close()
+
+        # wrong token is rejected too
+        bad = DistributedEngine([w.address], token="wrong")
+        try:
+            assert bad.datasets() == []
+        finally:
+            bad.close()
+    finally:
+        w.shutdown()
