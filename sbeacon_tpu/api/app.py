@@ -99,9 +99,13 @@ def bearer_token_verifier(token: str):
 
     def verify(method: str, path: str, headers: dict) -> tuple[bool, str]:
         got = _authorization_header(headers)
-        # constant-time compare: == short-circuits on the first differing
-        # byte, leaking token-prefix length via response timing
-        if not hmac.compare_digest(got, f"Bearer {token}"):
+        # constant-time compare (== short-circuits on the first differing
+        # byte, leaking token-prefix length via response timing); encoded
+        # to bytes because compare_digest raises TypeError on non-ASCII
+        # str, which would turn a malformed header into a 500
+        if not hmac.compare_digest(
+            got.encode(), f"Bearer {token}".encode()
+        ):
             return False, "invalid token"
         return True, ""
 

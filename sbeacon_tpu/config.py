@@ -101,6 +101,11 @@ class EngineConfig:
     window_cap: int = 2048
     record_cap: int = 1024
     batch_size: int = 1024
+    # mesh serving (SURVEY.md §2.5 fan-in mapping): when >1 device is
+    # visible, multi-dataset queries run as ONE pjit program over the
+    # dataset-sharded stack with psum fan-in (parallel/mesh.py) instead
+    # of per-shard thread scatter; single-device falls back to scatter
+    use_mesh: bool = True
     ingest_shard_bytes: int = 64 * 1024 * 1024
     ingest_workers: int = 8
     max_response_inline_bytes: int = 300 * 1024  # performQuery spill threshold
@@ -208,6 +213,13 @@ class BeaconConfig:
             eng_over["record_cap"] = int(env["BEACON_RECORD_CAP"])
         if "BEACON_USE_TPU" in env:
             eng_over["use_tpu"] = env["BEACON_USE_TPU"].lower() not in (
+                "0",
+                "false",
+                "no",
+                "off",
+            )
+        if "BEACON_USE_MESH" in env:
+            eng_over["use_mesh"] = env["BEACON_USE_MESH"].lower() not in (
                 "0",
                 "false",
                 "no",

@@ -26,6 +26,7 @@ shape caps and byte-exact (blob, not hash) allele comparison.
 from __future__ import annotations
 
 import logging
+import threading
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
@@ -341,6 +342,15 @@ class VariantEngine:
         self._scatter = ThreadPoolExecutor(
             max_workers=32, thread_name_prefix="engine-scatter"
         )
+        # mesh serving state (parallel/mesh.py StackedIndex + sharded
+        # arrays), rebuilt lazily after (re-)ingestion; None when <2
+        # devices are visible or use_mesh is off. mesh_searches counts
+        # queries answered by the one-pjit-program path (observability +
+        # the multichip dryrun asserts it engaged).
+        self._mesh_lock = threading.Lock()
+        self._mesh_state = None
+        self._mesh_dirty = True
+        self.mesh_searches = 0
 
     # -- index management ---------------------------------------------------
 
@@ -359,7 +369,12 @@ class VariantEngine:
                 key,
             )
             dindex = None
-        self._indexes[key] = (shard, dindex)
+        # publish + dirty-mark in one critical section: a concurrent
+        # search must never pair the new shard with a mesh stack built
+        # from the old one (_mesh_ready reads _indexes under this lock)
+        with self._mesh_lock:
+            self._mesh_dirty = True
+            self._indexes[key] = (shard, dindex)
 
     def close(self) -> None:
         """Release the scatter pool (same contract as
@@ -450,10 +465,21 @@ class VariantEngine:
         if not targets:
             return []
 
+        if len(targets) > 1:
+            state = self._mesh_ready()
+            if state is not None:
+                try:
+                    return self._mesh_search(
+                        state, targets, spec_base, payload, sp
+                    )
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "mesh search failed; falling back to thread scatter"
+                    )
+
         def _one_target(target):
             ds, vcf, shard, dindex, native = target
             selected_idx = None
-            ref = spec_base.reference_bases
             if payload.selected_samples_only:
                 # selected-samples leaf (reference performQuery/
                 # lambda_function.py:43-46 switches to
@@ -462,14 +488,9 @@ class VariantEngine:
                 # the in-samples regex semantics diverge from the exact
                 # kernel compare); counting is then sample-restricted in
                 # materialize_response via the genotype bit planes
-                wanted = payload.sample_names.get(ds, [])
-                universe = shard.meta.get("sample_names", [])
-                name_to_idx = {s: k for k, s in enumerate(universe)}
-                selected_idx = [
-                    name_to_idx[s] for s in wanted if s in name_to_idx
-                ]
-                if dindex is not None and (
-                    ref is None or "N" not in ref.upper()
+                selected_idx = self._selected_idx(shard, payload, ds)
+                if dindex is not None and self._device_ref_ok(
+                    payload, spec_base
                 ):
                     rows = self._device_rows(
                         shard, dindex, spec_base, ref_wildcard=True
@@ -500,4 +521,133 @@ class VariantEngine:
             # the per-shard device round-trips instead of serialising them
             responses = list(self._scatter.map(_one_target, targets))
         sp.note(targets=len(targets), responses=len(responses))
+        return responses
+
+    # -- mesh serving path --------------------------------------------------
+
+    @staticmethod
+    def _selected_idx(shard, payload, ds: str) -> list[int]:
+        wanted = payload.sample_names.get(ds, [])
+        universe = shard.meta.get("sample_names", [])
+        name_to_idx = {s: k for k, s in enumerate(universe)}
+        return [name_to_idx[s] for s in wanted if s in name_to_idx]
+
+    @staticmethod
+    def _device_ref_ok(payload, spec_base) -> bool:
+        """Device row-matching is exact for selected-samples queries unless
+        the query ref carries an N wildcard (regex semantics, host only)."""
+        if not payload.selected_samples_only:
+            return True
+        ref = spec_base.reference_bases
+        return ref is None or "N" not in ref.upper()
+
+    def _mesh_ready(self):
+        """(mesh, stacked, device_arrays, key->stack-position), built over
+        ALL loaded shards and cached until the index set changes; None when
+        mesh serving is off, <2 devices are visible, or bring-up failed
+        (thread-scatter then serves)."""
+        eng = self.config.engine
+        if not eng.use_mesh or not eng.use_tpu:
+            return None
+        with self._mesh_lock:
+            if not self._mesh_dirty:
+                return self._mesh_state
+            self._mesh_state = None
+            self._mesh_dirty = False
+            try:
+                import jax
+
+                from .parallel.mesh import StackedIndex, make_mesh
+
+                if len(jax.devices()) < 2 or len(self._indexes) < 2:
+                    return None
+                mesh = make_mesh()
+                keys = sorted(self._indexes)
+                shards = [self._indexes[k][0] for k in keys]
+                n_mesh = int(mesh.devices.size)
+                d_pad = -(-len(shards) // n_mesh) * n_mesh
+                stacked = StackedIndex(shards, n_datasets_padded=d_pad)
+                arrays = stacked.shard_to_mesh(mesh)
+                # the state carries its OWN shard snapshot: row ids from
+                # the stacked arrays are only valid against the exact
+                # shard objects the stack was built from, never against
+                # a concurrently re-ingested replacement
+                shard_of = dict(zip(keys, shards))
+                index_of = {k: i for i, k in enumerate(keys)}
+                self._mesh_state = (mesh, stacked, arrays, index_of, shard_of)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "mesh serving unavailable; using thread scatter"
+                )
+            return self._mesh_state
+
+    def _mesh_search(self, state, targets, spec_base, payload, sp):
+        """Multi-dataset query as ONE compiled program over the dataset-
+        sharded stack: every device answers the query against its local
+        shards and the cross-dataset aggregates fan in with psum — the
+        reference's 500-thread scatter + DynamoDB counter barrier
+        (search_variants.py:77-118, variant_queries.py:45-59) as a single
+        pjit dispatch. Per-dataset row ids come back device-sharded and
+        materialise host-side with the same cumulative semantics as the
+        scatter path."""
+        from .parallel.mesh import sharded_query
+
+        mesh, stacked, arrays, index_of, shard_of = state
+        eng = self.config.engine
+        per_ds, agg = sharded_query(
+            arrays,
+            [spec_base],
+            mesh=mesh,
+            n_iters=stacked.n_iters,
+            window_cap=eng.window_cap,
+            record_cap=eng.record_cap,
+        )
+        device_ref_ok = self._device_ref_ok(payload, spec_base)
+        ref_wild = payload.selected_samples_only
+
+        def _one(target):
+            ds, vcf, _shard, _dindex, native = target
+            # state-consistent shard: rows from the stacked arrays must
+            # materialise against the shard the stack was built from (a
+            # missing key means the dataset arrived after the stack was
+            # built — KeyError here falls back to thread scatter)
+            shard = shard_of[(ds, vcf)]
+            di = index_of[(ds, vcf)]
+            selected_idx = (
+                self._selected_idx(shard, payload, ds)
+                if payload.selected_samples_only
+                else None
+            )
+            overflow = (
+                bool(per_ds["overflow"][di, 0])
+                or int(per_ds["n_matched"][di, 0]) > eng.record_cap
+            )
+            if not device_ref_ok or overflow:
+                rows = host_match_rows(
+                    shard, spec_base, ref_wildcard=ref_wild
+                )
+            else:
+                r = per_ds["rows"][di, 0]
+                rows = r[r >= 0]
+            return materialize_response(
+                shard,
+                rows,
+                payload,
+                chrom_label=native,
+                dataset_id=ds,
+                vcf_location=vcf,
+                selected_idx=selected_idx,
+            )
+
+        if len(targets) == 1:
+            responses = [_one(targets[0])]
+        else:
+            responses = list(self._scatter.map(_one, targets))
+        self.mesh_searches += 1
+        sp.note(
+            targets=len(targets),
+            responses=len(responses),
+            mesh=int(mesh.devices.size),
+            psum_exists=bool(agg["exists"][0]),
+        )
         return responses

@@ -63,7 +63,11 @@ def _make_handler(engine, token: str = ""):
             if not token:
                 return True
             got = self.headers.get("Authorization", "")
-            return hmac.compare_digest(got, f"Bearer {token}")
+            # bytes compare: compare_digest raises TypeError on non-ASCII
+            # str, which would kill the request with no response
+            return hmac.compare_digest(
+                got.encode(), f"Bearer {token}".encode()
+            )
 
         def do_GET(self):
             if self.path == "/health":

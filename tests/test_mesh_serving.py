@@ -1,0 +1,239 @@
+"""Mesh serving path: multi-dataset queries through the dataset-sharded
+StackedIndex + psum fan-in as ONE pjit program (VariantEngine._mesh_search),
+asserted equal to the thread-scatter path and to the CPU oracle, end-to-end
+through BeaconApp. (Reference mapping: variantutils/search_variants.py:77-155
+scatter/fan-in collapsed into one compiled dispatch.)
+
+The conftest pins 8 virtual CPU devices, so the mesh path engages by default
+for every multi-dataset engine in the suite; this file pins down the
+contract explicitly.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu.config import BeaconConfig, EngineConfig, StorageConfig
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+SAMPLES = ["S0", "S1", "S2"]
+
+
+def _engines(n_ds=5, *, n=400, seed0=300, **eng_over):
+    """(mesh_engine, scatter_engine) over identical shard sets."""
+    out = []
+    for use_mesh in (True, False):
+        eng = VariantEngine(
+            BeaconConfig(
+                engine=EngineConfig(
+                    microbatch=False, use_mesh=use_mesh, **eng_over
+                )
+            )
+        )
+        for d in range(n_ds):
+            rng = random.Random(seed0 + d)
+            recs = random_records(rng, chrom="7", n=n, n_samples=len(SAMPLES))
+            eng.add_index(
+                build_index(
+                    recs,
+                    dataset_id=f"d{d}",
+                    vcf_location=f"v{d}.vcf.gz",
+                    sample_names=SAMPLES,
+                )
+            )
+        out.append(eng)
+    return out
+
+
+def _payload(**kw):
+    base = dict(
+        dataset_ids=[],
+        reference_name="7",
+        start_min=1,
+        start_max=1 << 30,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        include_datasets="HIT",
+        requested_granularity="record",
+    )
+    base.update(kw)
+    return VariantQueryPayload(**base)
+
+
+def _assert_same(rm, rt):
+    assert len(rm) == len(rt)
+    for a, b in zip(rm, rt):
+        assert (a.dataset_id, a.vcf_location) == (b.dataset_id, b.vcf_location)
+        assert a.exists == b.exists
+        assert a.call_count == b.call_count
+        assert a.all_alleles_count == b.all_alleles_count
+        assert a.variants == b.variants
+        assert a.sample_indices == b.sample_indices
+
+
+def test_mesh_engages_and_matches_scatter():
+    em, et = _engines()
+    pay = _payload()
+    rm, rt = em.search(pay), et.search(pay)
+    assert em.mesh_searches == 1 and et.mesh_searches == 0
+    _assert_same(rm, rt)
+    assert any(r.exists for r in rm)
+
+
+def test_mesh_dataset_subset_and_single_target():
+    em, et = _engines()
+    pay = _payload(dataset_ids=["d1", "d3"])
+    _assert_same(em.search(pay), et.search(pay))
+    assert em.mesh_searches == 1
+    # single-target queries stay on the scatter/batched path
+    pay1 = _payload(dataset_ids=["d2"])
+    _assert_same(em.search(pay1), et.search(pay1))
+    assert em.mesh_searches == 1
+
+
+def test_mesh_overflow_falls_back_to_host_rows():
+    # tiny caps force window overflow on broad queries: per-dataset rows
+    # must then come from the uncapped host matcher, identical to scatter
+    em, et = _engines(window_cap=16, record_cap=8)
+    pay = _payload()
+    rm, rt = em.search(pay), et.search(pay)
+    assert em.mesh_searches == 1
+    _assert_same(rm, rt)
+    # the corpus has far more than 8 variants per dataset: fallback proved
+    assert sum(len(r.variants) for r in rm) > 8
+
+
+def test_mesh_selected_samples_parity():
+    em, et = _engines()
+    pay = _payload(
+        selected_samples_only=True,
+        sample_names={f"d{d}": ["S0", "S2"] for d in range(5)},
+        include_samples=True,
+    )
+    rm, rt = em.search(pay), et.search(pay)
+    assert em.mesh_searches == 1
+    _assert_same(rm, rt)
+
+
+def test_mesh_point_and_type_queries_parity():
+    em, et = _engines()
+    rng = random.Random(9)
+    shard0 = em._indexes[("d0", "v0.vcf.gz")][0]
+    for _ in range(10):
+        r = rng.randrange(shard0.n_rows)
+        pos = int(shard0.cols["pos"][r])
+        pay = _payload(
+            start_min=pos,
+            start_max=pos,
+            alternate_bases=None,
+            variant_type=rng.choice(["DEL", "INS", "DUP", "CNV", None]),
+        )
+        _assert_same(em.search(pay), et.search(pay))
+
+
+def test_reingestion_invalidates_mesh_stack():
+    em, et = _engines(n_ds=3)
+    pay = _payload()
+    _assert_same(em.search(pay), et.search(pay))
+    # add a new dataset: the stack must rebuild and serve it
+    rng = random.Random(999)
+    recs = random_records(rng, chrom="7", n=200, n_samples=len(SAMPLES))
+    for eng in (em, et):
+        eng.add_index(
+            build_index(
+                recs,
+                dataset_id="late",
+                vcf_location="late.vcf.gz",
+                sample_names=SAMPLES,
+            )
+        )
+    rm, rt = em.search(pay), et.search(pay)
+    assert {r.dataset_id for r in rm} == {"d0", "d1", "d2", "late"}
+    _assert_same(rm, rt)
+    assert em.mesh_searches == 2
+
+
+def test_mesh_vs_oracle_aggregates():
+    """Mesh-path responses match the CPU oracle record-by-record."""
+    from sbeacon_tpu.oracle import oracle_search
+
+    em, _ = _engines(n_ds=3, n=150)
+    pay = _payload(start_min=1, start_max=40_000)
+    rm = em.search(pay)
+    assert em.mesh_searches == 1
+    for d in range(3):
+        rng = random.Random(300 + d)
+        recs = random_records(rng, chrom="7", n=150, n_samples=len(SAMPLES))
+        want = oracle_search(
+            recs,
+            first_bp=1,
+            last_bp=40_000,
+            end_min=1,
+            end_max=1 << 30,
+            reference_bases=None,
+            alternate_bases="N",
+            requested_granularity="record",
+            include_details=True,
+            dataset_id=f"d{d}",
+            chrom_label="7",
+        )
+        got = next(r for r in rm if r.dataset_id == f"d{d}")
+        assert got.exists == want.exists
+        assert got.call_count == want.call_count
+        assert got.all_alleles_count == want.all_alleles_count
+
+
+def test_beacon_app_serves_through_mesh(tmp_path):
+    """End-to-end: /submit two datasets, then a /g_variants POST executes
+    via the mesh path (engine.mesh_searches increments) with a correct
+    Beacon envelope."""
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.genomics.tabix import ensure_index
+    from sbeacon_tpu.genomics.vcf import write_vcf
+
+    config = BeaconConfig(storage=StorageConfig(root=tmp_path / "data"))
+    config.storage.ensure()
+    app = BeaconApp(config)
+    for d in range(2):
+        rng = random.Random(70 + d)
+        recs = random_records(rng, chrom="22", n=80, n_samples=len(SAMPLES))
+        vcf = tmp_path / f"m{d}.vcf.gz"
+        write_vcf(vcf, recs, sample_names=SAMPLES)
+        ensure_index(vcf)
+        status, body = app.handle(
+            "POST",
+            "/submit",
+            body={
+                "datasetId": f"m{d}",
+                "assemblyId": "GRCh38",
+                "vcfLocations": [str(vcf)],
+                "dataset": {"id": f"m{d}", "name": f"M{d}"},
+                "index": True,
+            },
+        )
+        assert status == 200, body
+    before = app.engine.mesh_searches
+    status, body = app.handle(
+        "POST",
+        "/g_variants",
+        body={
+            "query": {
+                "requestedGranularity": "count",
+                "requestParameters": {
+                    "assemblyId": "GRCh38",
+                    "referenceName": "22",
+                    "start": [1, 1 << 30],
+                    "end": [1, 1 << 30],
+                    "alternateBases": "N",
+                },
+            }
+        },
+    )
+    assert status == 200, body
+    assert body["responseSummary"]["exists"] is True
+    assert app.engine.mesh_searches == before + 1
