@@ -33,7 +33,8 @@ import numpy as np
 
 from .config import BeaconConfig
 from .index.columnar import FLAG, VariantIndexShard
-from .ops.kernel import DeviceIndex, QuerySpec, run_queries
+from .ops import make_device_index, run_queries_auto
+from .ops.kernel import QuerySpec
 from .payloads import VariantQueryPayload, VariantSearchResponse
 from .utils.chrom import chromosome_code
 from .utils.trace import span
@@ -357,7 +358,9 @@ class VariantEngine:
     def add_index(self, shard: VariantIndexShard) -> None:
         key = (shard.meta.get("dataset_id", ""), shard.meta.get("vcf_location", ""))
         try:
-            dindex = DeviceIndex(shard)
+            dindex = make_device_index(
+                shard, window=self.config.engine.window_cap
+            )
         except Exception:
             # accelerator unavailable (backend init failure, OOM): serve
             # from the host matcher instead of failing ingestion/queries —
@@ -413,7 +416,7 @@ class VariantEngine:
     def _device_rows(
         self,
         shard: VariantIndexShard,
-        dindex: "DeviceIndex",
+        dindex,
         spec: QuerySpec,
         *,
         ref_wildcard: bool = False,
@@ -431,7 +434,7 @@ class VariantEngine:
                 record_cap=eng.record_cap,
             )
         else:
-            res = run_queries(
+            res = run_queries_auto(
                 dindex,
                 [spec],
                 window_cap=eng.window_cap,

@@ -8,8 +8,42 @@ from .kernel import (
 from .pallas_kernel import (
     HAVE_PALLAS,
     PallasDeviceIndex,
+    run_queries_grouped,
     run_queries_pallas,
 )
+
+
+def make_device_index(
+    shard, *, window: int | None = None, pad_unit: int | None = None
+):
+    """Device index for serving: the grouped Pallas window-scan kernel on
+    real TPU backends (tile-shared DMA + in-kernel row materialisation),
+    the XLA gather kernel elsewhere (Pallas interpret mode is far slower
+    than XLA on CPU). ``window`` should match the engine's window_cap so
+    candidate ranges the config promises to answer on-device actually
+    stay on-device (capped at 2048 lanes to bound the kernel's VMEM)."""
+    import jax
+
+    if HAVE_PALLAS and jax.default_backend() == "tpu":
+        w = min(window or 512, 2048)
+        w = max(128, ((w + 127) // 128) * 128)
+        return PallasDeviceIndex(shard, window=w)
+    return DeviceIndex(shard, pad_unit=pad_unit)
+
+
+def run_queries_auto(
+    index, queries, *, window_cap: int = 2048, record_cap: int = 1024
+) -> QueryResults:
+    """Dispatch a query batch to whichever kernel the index was built
+    for — one call site for the engine and the micro-batcher."""
+    if isinstance(index, PallasDeviceIndex):
+        return run_queries_grouped(
+            index, queries, window_cap=window_cap, record_cap=record_cap
+        )
+    return run_queries(
+        index, queries, window_cap=window_cap, record_cap=record_cap
+    )
+
 
 __all__ = [
     "DeviceIndex",
@@ -18,6 +52,9 @@ __all__ = [
     "QueryResults",
     "QuerySpec",
     "encode_queries",
+    "make_device_index",
     "run_queries",
+    "run_queries_auto",
+    "run_queries_grouped",
     "run_queries_pallas",
 ]

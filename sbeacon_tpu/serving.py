@@ -25,7 +25,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-from .ops.kernel import QueryResults, encode_queries, run_queries
+from .ops import run_queries_auto
+from .ops.kernel import QueryResults, encode_queries
 from .utils.trace import span
 
 
@@ -181,7 +182,7 @@ class MicroBatcher:
                 enc = encode_queries(specs)
                 n_pad = bucket_size(len(specs), self.max_batch)
                 enc = _pad_encoded(enc, n_pad)
-                res = run_queries(
+                res = run_queries_auto(
                     dindex,
                     enc,
                     window_cap=window_cap,

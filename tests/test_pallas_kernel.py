@@ -100,18 +100,24 @@ def _queries(shard):
 
 
 def test_pallas_matches_xla(dataset):
+    """Non-overflow queries match the XLA kernel exactly; the grouped
+    kernel may flag MORE queries overflow (VT_OTHER / unrepresentable
+    fields are host-resolved by contract), never fewer."""
     shard, dindex, pindex = dataset
     qs = _queries(shard)
     want = run_queries(dindex, qs, window_cap=512, record_cap=512)
     got = run_queries_pallas(pindex, qs)
-    np.testing.assert_array_equal(got["overflow"], want.overflow)
-    np.testing.assert_array_equal(got["exists"], want.exists)
-    np.testing.assert_array_equal(got["call_count"], want.call_count)
-    np.testing.assert_array_equal(got["n_variants"], want.n_variants)
-    np.testing.assert_array_equal(
-        got["all_alleles_count"], want.all_alleles_count
-    )
-    np.testing.assert_array_equal(got["n_matched"], want.n_matched)
+    assert (got["overflow"] | ~want.overflow).all()  # superset
+    ok = ~got["overflow"]
+    assert ok.sum() > len(qs) // 2  # the host path must stay the exception
+    for key, ref in (
+        ("exists", want.exists),
+        ("call_count", want.call_count),
+        ("n_variants", want.n_variants),
+        ("all_alleles_count", want.all_alleles_count),
+        ("n_matched", want.n_matched),
+    ):
+        np.testing.assert_array_equal(got[key][ok], ref[ok], err_msg=key)
 
 
 def test_pallas_overflow_flag(dataset):
@@ -122,3 +128,118 @@ def test_pallas_overflow_flag(dataset):
         pindex, [QuerySpec("1", 1, 1 << 30, 1, 1 << 30, alternate_bases="N")]
     )
     assert bool(got["overflow"][0])
+
+
+def test_grouped_rows_match_xla(dataset):
+    """Row-id materialisation in-Pallas (packed match masks) must produce
+    exactly the XLA kernel's ordered row ids."""
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    shard, dindex, pindex = dataset
+    qs = _queries(shard)
+    want = run_queries(dindex, qs, window_cap=512, record_cap=512)
+    got = run_queries_grouped(pindex, qs, window_cap=512, record_cap=512)
+    assert (got.overflow | ~want.overflow).all()  # superset
+    for i in range(len(qs)):
+        if got.overflow[i]:
+            continue  # rows undefined on overflow (host path takes over)
+        np.testing.assert_array_equal(got.rows[i], want.rows[i], err_msg=f"q{i}")
+        assert int(got.call_count[i]) == int(want.call_count[i])
+        assert int(got.n_matched[i]) == int(want.n_matched[i])
+
+
+def test_grouped_record_cap_clips(dataset):
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    shard, dindex, pindex = dataset
+    q = [QuerySpec("1", 1, 1 << 20, 1, 1 << 30, alternate_bases="N")]
+    want = run_queries(dindex, q, window_cap=512, record_cap=4)
+    got = run_queries_grouped(pindex, q, window_cap=512, record_cap=4)
+    assert got.rows.shape == (1, 4)
+    np.testing.assert_array_equal(got.rows, want.rows)
+    assert int(got.n_matched[0]) == int(want.n_matched[0])
+
+
+def test_grouped_sparse_queries_split_groups(dataset):
+    """Queries scattered across the index force greedy group splits; each
+    still answers exactly (no silent truncation across tile spans)."""
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    shard, dindex, pindex = dataset
+    pos = shard.cols["pos"]
+    qs = []
+    for r in range(0, shard.n_rows, max(1, shard.n_rows // 37)):
+        p = int(pos[r])
+        chrom = shard.row_chrom(r)
+        qs.append(QuerySpec(chrom, p, p, 1, 1 << 30, alternate_bases="N"))
+    want = run_queries(dindex, qs, window_cap=512, record_cap=64)
+    got = run_queries_grouped(pindex, qs, window_cap=512, record_cap=64)
+    np.testing.assert_array_equal(got.exists, want.exists)
+    np.testing.assert_array_equal(got.call_count, want.call_count)
+    np.testing.assert_array_equal(got.all_alleles_count, want.all_alleles_count)
+    np.testing.assert_array_equal(got.rows, want.rows)
+
+
+def test_grouped_large_batch_chunks(dataset):
+    """>CHUNK slots exercises the lax.map chunk loop + dummy group pad."""
+    import random as _r
+
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    shard, dindex, pindex = dataset
+    rng = _r.Random(3)
+    pos = shard.cols["pos"]
+    qs = []
+    for _ in range(1100):
+        p = int(pos[rng.randrange(len(pos))])
+        qs.append(
+            QuerySpec(
+                rng.choice(["1", "22"]), p, p, 1, 1 << 30, alternate_bases="N"
+            )
+        )
+    want = run_queries(dindex, qs, window_cap=512, record_cap=16)
+    got = run_queries_grouped(pindex, qs, window_cap=512, record_cap=16)
+    np.testing.assert_array_equal(got.exists, want.exists)
+    np.testing.assert_array_equal(got.call_count, want.call_count)
+    np.testing.assert_array_equal(got.rows, want.rows)
+
+
+def test_grouped_long_insertion_not_dropped():
+    """Row alt_len is an unclamped int32 (a 70 kb literal insertion is a
+    legal row); an unbounded query must still match it — the 16-bit
+    max_len field uses a sentinel, not a silent ceiling."""
+    from sbeacon_tpu.genomics.vcf import VcfRecord
+    from sbeacon_tpu.index import build_index
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    big_alt = "A" + "CGT" * 23335  # 70,006 bp
+    recs = [
+        VcfRecord(
+            chrom="1", pos=1000, ref="A", alts=["G"],
+            ac=[1], an=4, vt="N/A", genotypes=[],
+        ),
+        VcfRecord(
+            chrom="1", pos=2000, ref="A", alts=[big_alt],
+            ac=[2], an=4, vt="N/A", genotypes=[],
+        ),
+    ]
+    shard = build_index(recs, dataset_id="d")
+    pindex_ = PallasDeviceIndex(shard, window=128)
+    dindex_ = DeviceIndex(shard, pad_unit=1024)
+    q = [QuerySpec("1", 1, 10_000, 1, 1 << 30, variant_type="INS")]
+    want = run_queries(dindex_, q, window_cap=128, record_cap=8)
+    got = run_queries_grouped(pindex_, q, window_cap=128, record_cap=8)
+    assert bool(want.exists[0]) is True
+    assert not got.overflow[0]
+    assert bool(got.exists[0]) is True
+    assert int(got.call_count[0]) == int(want.call_count[0]) == 2
+    np.testing.assert_array_equal(got.rows, want.rows)
+    # a finite max_len the 16-bit field cannot represent goes to host
+    q2 = [
+        QuerySpec(
+            "1", 1, 10_000, 1, 1 << 30,
+            variant_type="INS", variant_max_length=70_000,
+        )
+    ]
+    got2 = run_queries_grouped(pindex_, q2, window_cap=128, record_cap=8)
+    assert bool(got2.overflow[0])
