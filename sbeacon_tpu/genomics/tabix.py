@@ -131,7 +131,9 @@ def _parse_tabix_aux(aux: bytes) -> tuple[dict, list[str]]:
 
 
 def parse_tbi(path: str | Path) -> TabixIndex:
-    data = gzip.decompress(Path(path).read_bytes())
+    from ..io import read_bytes
+
+    data = gzip.decompress(read_bytes(path))
     if data[:4] != b"TBI\x01":
         raise ValueError("bad .tbi magic")
     (n_ref,) = struct.unpack_from("<i", data, 4)
@@ -161,7 +163,9 @@ def parse_tbi(path: str | Path) -> TabixIndex:
 
 
 def parse_csi(path: str | Path) -> TabixIndex:
-    data = gzip.decompress(Path(path).read_bytes())
+    from ..io import read_bytes
+
+    data = gzip.decompress(read_bytes(path))
     if data[:4] != b"CSI\x01":
         raise ValueError("bad .csi magic")
     min_shift, depth, l_aux = struct.unpack_from("<3i", data, 4)
@@ -199,11 +203,43 @@ def parse_index(path: str | Path) -> TabixIndex:
     return parse_tbi(path)
 
 
+# parsed-index cache for REMOTE locations only: one submission touches the
+# index from the reachability probe, the chromosome map, and the slice
+# planner — without a cache that is 3 full .tbi transfers through an
+# object store per VCF. Local paths stay uncached (tests and re-indexing
+# rewrite them in place). Entries expire so a re-uploaded index is seen.
+_REMOTE_IDX_CACHE: dict[str, tuple[float, "TabixIndex | None"]] = {}
+_REMOTE_IDX_TTL_S = 60.0
+_REMOTE_IDX_MAX = 256
+
+
 def find_index_for(vcf_path: str | Path) -> TabixIndex | None:
-    """Locate and parse the .tbi/.csi next to a VCF, if present."""
+    """Locate and parse the .tbi/.csi next to a VCF, if present — local
+    path or remote object (the reference's S3 layout keeps the index at
+    the same key + extension, summariseVcf/lambda_function.py get_vcf_index).
+    """
+    import time as _time
+
+    from ..io import is_remote, open_source
+
+    key = str(vcf_path)
+    if is_remote(key):
+        hit = _REMOTE_IDX_CACHE.get(key)
+        if hit is not None and _time.monotonic() - hit[0] < _REMOTE_IDX_TTL_S:
+            return hit[1]
+        idx = None
+        for ext in (".tbi", ".csi"):
+            cand = key + ext
+            if open_source(cand).exists():
+                idx = parse_index(cand)
+                break
+        if len(_REMOTE_IDX_CACHE) >= _REMOTE_IDX_MAX:
+            _REMOTE_IDX_CACHE.clear()
+        _REMOTE_IDX_CACHE[key] = (_time.monotonic(), idx)
+        return idx
     for ext in (".tbi", ".csi"):
-        cand = Path(str(vcf_path) + ext)
-        if cand.exists():
+        cand = key + ext
+        if Path(cand).exists():
             return parse_index(cand)
     return None
 
@@ -268,10 +304,18 @@ def write_tbi(idx: TabixIndex, path: str | Path) -> None:
 def ensure_index(vcf_path: str | Path) -> TabixIndex:
     """Parse the existing .tbi/.csi, or self-index the VCF and persist the
     result (the framework's replacement for requiring external ``tabix``
-    runs before submission)."""
+    runs before submission). Remote objects cannot be self-indexed in
+    place — like the reference, they must ship with their index."""
+    from ..io import is_remote
+
     idx = find_index_for(vcf_path)
     if idx is not None:
         return idx
+    if is_remote(vcf_path):
+        raise ValueError(
+            f"remote VCF {vcf_path} has no .tbi/.csi alongside it; "
+            "remote submissions must be pre-indexed"
+        )
     idx = build_tbi(vcf_path)
     write_tbi(idx, str(vcf_path) + ".tbi")
     return idx

@@ -258,7 +258,22 @@ def export_region_files(
                 start, raw_bytes = i, 0
             raw_bytes += int(rec_raw[i - lo])
         flush(start, hi, raw_bytes)
+    _write_manifest(out_dir)
     return written
+
+
+def _write_manifest(out_dir: Path) -> None:
+    """Regenerate ``manifest.txt`` (one relative region path per line).
+
+    Object stores have no directory listing over plain HTTP, so the
+    manifest is the export's self-describing key list — the role S3
+    ListObjects plays for the reference's vcf-summaries/ prefix
+    (initDuplicateVariantSearch.py get_object_list)."""
+    lines = sorted(
+        str(p.relative_to(out_dir))
+        for p in out_dir.glob("contig/*/*/regions/*")
+    )
+    (out_dir / "manifest.txt").write_text("\n".join(lines) + "\n")
 
 
 def parse_region_filename(path: str | Path) -> tuple[int, int, int]:
@@ -270,7 +285,21 @@ def parse_region_filename(path: str | Path) -> tuple[int, int, int]:
 
 def iter_region_files(root: str | Path):
     """Yield (chrom, location, path, start, end, raw_size) under an export
-    root."""
+    root — a local directory, or a remote (http(s)/s3) root whose
+    ``manifest.txt`` lists the region keys."""
+    from ..io import is_remote, read_bytes
+
+    if is_remote(root):
+        base = str(root).rstrip("/")
+        for rel in read_bytes(f"{base}/manifest.txt").decode().splitlines():
+            rel = rel.strip()
+            if not rel:
+                continue
+            parts = rel.split("/")
+            chrom, location = parts[1], parts[2]
+            start, end, size = parse_region_filename(parts[-1])
+            yield chrom, location, f"{base}/{rel}", start, end, size
+        return
     root = Path(root)
     for path in sorted(root.glob("contig/*/*/regions/*")):
         chrom = path.parts[-4]
@@ -288,13 +317,15 @@ def distinct_variant_count_files(
     """Distinct (contig, pos, payload) across exported datasets — the
     duplicateVariantSearch tally (duplicateVariantSearch.cpp:31-84) over
     the portable files instead of live shards."""
+    from ..io import read_bytes
+
     seen: set[tuple[str, int, bytes]] = set()
     for root in roots:
         for chrom, _loc, path, start, end, _size in iter_region_files(root):
             if end < range_start or start > range_end:
                 continue
             positions, payloads = unpack_records(
-                path.read_bytes(), range_start, range_end
+                read_bytes(path), range_start, range_end
             )
             for p, pay in zip(positions.tolist(), payloads):
                 seen.add((chrom, int(p), bytes(pay)))

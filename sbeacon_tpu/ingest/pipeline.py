@@ -53,10 +53,12 @@ def read_slice_records(
     completed from the python reader's line iterator semantics: slices are
     planned on chunk boundaries (record starts), which makes the naive
     range exact here."""
+    from ..io import is_remote
+
     try:
         from .. import native
 
-        if native.prefer_native_io():
+        if native.prefer_native_io() and not is_remote(vcf_path):
             text = native.inflate_range(str(vcf_path), vstart, vend)
             records = []
             for line in text.split(b"\n"):

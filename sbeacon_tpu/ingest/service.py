@@ -51,19 +51,30 @@ class IngestService:
     def check_vcf_locations(self, vcf_locations: list[str]) -> list[dict]:
         """Probe every VCF; returns the chromosome map entries the dataset
         doc carries (reference VcfChromosomeMap items {vcf, chromosomes})."""
+        from ..io import is_remote, open_source
+
         chrom_map = []
         errors = []
         for vcf in set(vcf_locations):
-            p = Path(vcf)
-            if not p.exists():
+            if is_remote(vcf):
+                # object-store location (http(s)/s3, the reference's
+                # native habitat): probe reachability by ranged read
+                try:
+                    if not open_source(vcf).exists():
+                        errors.append(f"Could not find object {vcf}")
+                        continue
+                except Exception as e:
+                    errors.append(f"Could not reach {vcf}: {e}")
+                    continue
+            elif not Path(vcf).exists():
                 errors.append(f"Could not find file {vcf}")
                 continue
             try:
-                # self-index when no .tbi/.csi accompanies the file —
+                # self-index when no .tbi/.csi accompanies a local file —
                 # unlike the reference, submission does not require an
-                # external ``tabix`` run
-                ensure_index(p)
-                chroms = list_chromosomes(p)
+                # external ``tabix`` run (remote objects must ship theirs)
+                ensure_index(vcf)
+                chroms = list_chromosomes(vcf)
             except Exception as e:
                 errors.append(f"Could not index {vcf}: {e}")
                 continue

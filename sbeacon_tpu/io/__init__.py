@@ -1,0 +1,19 @@
+from .sources import (
+    ByteSource,
+    HttpRangeSource,
+    LocalFileSource,
+    RemoteIOError,
+    is_remote,
+    open_source,
+    read_bytes,
+)
+
+__all__ = [
+    "ByteSource",
+    "HttpRangeSource",
+    "LocalFileSource",
+    "RemoteIOError",
+    "is_remote",
+    "open_source",
+    "read_bytes",
+]

@@ -1,0 +1,257 @@
+"""Pluggable ranged-read byte sources: the object-store data plane.
+
+The reference's defining I/O pattern is VCFs and index slices living in
+object storage, read by concurrent ranged GETs (reference:
+lambda/summariseSlice/source/downloader.h:70-91 one ranged GET per
+thread; vcf_chunk_reader.h:69-105 4-thread download ring;
+performQuery/search_variants.py:42-50 ``bcftools query s3://...``).
+This module re-homes that capability behind one small interface:
+
+    source = open_source("http://host/cohort/chr1.vcf.gz")
+    source.read_range(start, end)          # one ranged GET
+    source.read_range(start, end, workers=4)  # chunked concurrent GETs
+
+Supported schemes:
+
+- local paths (no scheme or ``file://``) — mmap-free plain reads;
+- ``http(s)://`` — HTTP Range requests with retries; servers that ignore
+  Range fall back to a cached whole-object GET;
+- ``s3://bucket/key`` — mapped onto the HTTP backend against an
+  S3-compatible endpoint (``BEACON_S3_ENDPOINT``, path-style), with an
+  optional static ``Authorization`` header (``BEACON_S3_TOKEN``). Real
+  AWS SigV4 signing is intentionally out of scope: deployments use
+  presigned URLs, an authenticating gateway, or an S3-compatible store
+  that accepts bearer/anonymous reads (the reference delegates the same
+  concern to IAM roles outside its code).
+
+Every read retries transient failures (the reference wraps each S3 GET
+in a retry loop, shared/awsutils.cpp:62-65).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+from pathlib import Path
+from urllib.parse import urlparse
+
+
+class RemoteIOError(IOError):
+    """A remote object is unreachable/missing (400/404 at the API edge)."""
+
+
+_SCHEMES = ("http://", "https://", "s3://")
+
+
+def is_remote(location: str | Path) -> bool:
+    return str(location).startswith(_SCHEMES)
+
+
+def resolve_s3(url: str) -> tuple[str, dict]:
+    """s3://bucket/key -> (http url, headers) via the configured
+    S3-compatible endpoint."""
+    endpoint = os.environ.get("BEACON_S3_ENDPOINT", "")
+    if not endpoint:
+        raise RemoteIOError(
+            f"cannot read {url}: set BEACON_S3_ENDPOINT to an "
+            "S3-compatible HTTP endpoint (path-style)"
+        )
+    parsed = urlparse(url)
+    headers = {}
+    token = os.environ.get("BEACON_S3_TOKEN", "")
+    if token:
+        headers["Authorization"] = token
+    return (
+        f"{endpoint.rstrip('/')}/{parsed.netloc}{parsed.path}",
+        headers,
+    )
+
+
+class ByteSource:
+    """Random-access byte reads over one object."""
+
+    location: str
+
+    def exists(self) -> bool:
+        raise NotImplementedError
+
+    def size(self) -> int:
+        raise NotImplementedError
+
+    def read_range(self, start: int, end: int, *, workers: int = 1) -> bytes:
+        """Bytes in [start, end) (clamped to the object's size)."""
+        raise NotImplementedError
+
+    def read_all(self) -> bytes:
+        return self.read_range(0, self.size())
+
+
+class LocalFileSource(ByteSource):
+    def __init__(self, path: str | Path):
+        self.location = str(path)
+        self._path = Path(path)
+
+    def exists(self) -> bool:
+        return self._path.exists()
+
+    def size(self) -> int:
+        return self._path.stat().st_size
+
+    def read_range(self, start: int, end: int, *, workers: int = 1) -> bytes:
+        with open(self._path, "rb") as fh:
+            fh.seek(start)
+            return fh.read(max(0, end - start))
+
+    def read_all(self) -> bytes:
+        return self._path.read_bytes()
+
+
+class HttpRangeSource(ByteSource):
+    """HTTP(S) object with Range reads, retries, and concurrent chunking.
+
+    The ``workers`` path is the downloader.h role: [start, end) split into
+    ``chunk_bytes`` pieces fetched by a thread pool, reassembled in order.
+    A server that answers 200 to a Range request (no range support) gets
+    one whole-object GET whose body is cached for later reads.
+    """
+
+    def __init__(
+        self,
+        url: str,
+        *,
+        headers: dict | None = None,
+        retries: int = 3,
+        timeout_s: float = 60.0,
+        chunk_bytes: int = 8 * 1024 * 1024,
+    ):
+        self.location = url
+        if url.startswith("s3://"):
+            url, s3_headers = resolve_s3(url)
+            headers = {**s3_headers, **(headers or {})}
+        self._url = url
+        self._headers = dict(headers or {})
+        self._retries = retries
+        self._timeout_s = timeout_s
+        self._chunk_bytes = chunk_bytes
+        self._size: int | None = None
+        self._whole: bytes | None = None  # cache when Range is unsupported
+
+    # -- low-level ----------------------------------------------------------
+
+    def _request(self, extra_headers: dict, method: str = "GET"):
+        req = urllib.request.Request(
+            self._url,
+            headers={**self._headers, **extra_headers},
+            method=method,
+        )
+        return urllib.request.urlopen(req, timeout=self._timeout_s)
+
+    def _with_retries(self, fn):
+        last: Exception | None = None
+        for attempt in range(self._retries + 1):
+            try:
+                return fn()
+            except urllib.error.HTTPError as e:
+                if e.code in (404, 403, 401, 416):
+                    raise RemoteIOError(
+                        f"{self.location}: HTTP {e.code}"
+                    ) from e
+                last = e
+            except Exception as e:  # connection resets, timeouts
+                last = e
+            if attempt < self._retries:
+                time.sleep(min(0.2 * (attempt + 1), 1.0))
+        raise RemoteIOError(f"{self.location}: {last}") from last
+
+    # -- ByteSource ---------------------------------------------------------
+
+    def exists(self) -> bool:
+        try:
+            self.size()
+            return True
+        except RemoteIOError:
+            return False
+
+    def size(self) -> int:
+        if self._size is not None:
+            return self._size
+        if self._whole is not None:
+            self._size = len(self._whole)
+            return self._size
+
+        def probe():
+            # a 1-byte ranged GET beats HEAD: it also tells us whether the
+            # server honours Range at all
+            with self._request({"Range": "bytes=0-0"}) as resp:
+                if resp.status == 206:
+                    cr = resp.headers.get("Content-Range", "")
+                    if "/" in cr:
+                        return int(cr.rsplit("/", 1)[1]), None
+                    # 206 without a parseable Content-Range: the 1-byte
+                    # body must NOT be cached as the whole object —
+                    # fall through to a plain full GET below
+                else:
+                    # 200: server ignored Range — body is the whole object
+                    body = resp.read()
+                    return len(body), body
+            with self._request({}) as resp:
+                body = resp.read()
+                return len(body), body
+
+        n, body = self._with_retries(probe)
+        self._size = n
+        if body is not None:
+            self._whole = body
+        return n
+
+    def _get_range(self, start: int, end: int) -> bytes:
+        def fetch():
+            hdr = {"Range": f"bytes={start}-{end - 1}"}
+            with self._request(hdr) as resp:
+                body = resp.read()
+                if resp.status == 206:
+                    return body
+                # 200: server ignored Range — body is the whole object
+                self._whole = body
+                self._size = len(body)
+                return body[start:end]
+
+        return self._with_retries(fetch)
+
+    def read_range(self, start: int, end: int, *, workers: int = 1) -> bytes:
+        end = min(end, self.size())
+        start = min(start, end)
+        if end <= start:
+            return b""
+        if self._whole is not None:
+            return self._whole[start:end]
+        n = end - start
+        if workers <= 1 or n <= self._chunk_bytes:
+            return self._get_range(start, end)
+        bounds = list(range(start, end, self._chunk_bytes)) + [end]
+        with ThreadPoolExecutor(min(workers, len(bounds) - 1)) as pool:
+            parts = list(
+                pool.map(
+                    lambda se: self._get_range(*se),
+                    zip(bounds[:-1], bounds[1:]),
+                )
+            )
+        return b"".join(parts)
+
+
+def open_source(location: str | Path, **kwargs) -> ByteSource:
+    loc = str(location)
+    if loc.startswith(("http://", "https://", "s3://")):
+        return HttpRangeSource(loc, **kwargs)
+    if loc.startswith("file://"):
+        return LocalFileSource(loc[len("file://"):])
+    return LocalFileSource(loc)
+
+
+def read_bytes(location: str | Path) -> bytes:
+    """Whole-object read for any supported scheme (small control files:
+    .tbi/.csi indexes, portable region files)."""
+    return open_source(location).read_all()

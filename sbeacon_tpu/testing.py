@@ -109,3 +109,83 @@ def make_test_vcf(
         )
     write_vcf(path, records, sample_names=[f"S{i:04d}" for i in range(n_samples)])
     return records
+
+
+# ---------------------------------------------------------------------------
+# Range-supporting HTTP object server (tests + demos of the object-store
+# data plane; stdlib http.server does not honour Range)
+# ---------------------------------------------------------------------------
+
+
+def range_server(directory: str | Path, *, require_token: str = ""):
+    """Context manager serving ``directory`` over HTTP with Range support.
+
+    Yields the base URL. Emulates the object-store role (ranged GETs per
+    reference downloader.h); ``require_token`` additionally demands an
+    ``Authorization`` header equal to it (for exercising the s3://
+    BEACON_S3_TOKEN path).
+    """
+    import contextlib
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    root = Path(directory)
+
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if require_token and (
+                self.headers.get("Authorization", "") != require_token
+            ):
+                self.send_response(403)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            target = (root / self.path.lstrip("/")).resolve()
+            if not str(target).startswith(str(root.resolve())) or (
+                not target.is_file()
+            ):
+                self.send_response(404)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            data = target.read_bytes()
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                spec = rng[len("bytes="):]
+                start_s, _, end_s = spec.partition("-")
+                start = int(start_s) if start_s else 0
+                end = int(end_s) + 1 if end_s else len(data)
+                end = min(end, len(data))
+                if start >= len(data):
+                    self.send_response(416)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                body = data[start:end]
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range", f"bytes {start}-{end - 1}/{len(data)}"
+                )
+            else:
+                body = data
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+            self.wfile.write(body)
+
+    @contextlib.contextmanager
+    def _cm():
+        server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        try:
+            yield f"http://127.0.0.1:{server.server_address[1]}"
+        finally:
+            server.shutdown()
+            server.server_close()
+
+    return _cm()
