@@ -485,6 +485,78 @@ def config5_sv_indel(records, shard):
     }
 
 
+def config6_ingest():
+    """Ingest throughput: single-host sliced pipeline vs slice scans
+    scattered over 2 worker hosts (in-process here — the scaling story is
+    the path, reference: summariseVcf <=1000-lambda fan-out)."""
+    import tempfile
+    from pathlib import Path
+
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        IngestConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.genomics.tabix import ensure_index
+    from sbeacon_tpu.genomics.vcf import write_vcf
+    from sbeacon_tpu.ingest.pipeline import SummarisationPipeline
+    from sbeacon_tpu.parallel.dispatch import ScanWorkerPool, WorkerServer
+    from sbeacon_tpu.testing import random_records
+
+    n_records = 30_000
+    with tempfile.TemporaryDirectory(prefix="bench-ingest-") as td:
+        root = Path(td)
+        rng = random.Random(41)
+        recs = random_records(
+            rng, chrom="2", n=n_records, n_samples=4, spacing=60
+        )
+        vcf = root / "ingest.vcf.gz"
+        write_vcf(vcf, recs, sample_names=[f"S{i}" for i in range(4)])
+        ensure_index(vcf)
+
+        def run(name, scan_pool):
+            config = BeaconConfig(
+                storage=StorageConfig(root=root / name),
+                ingest=IngestConfig(workers=8),
+            )
+            config.storage.ensure()
+            pipe = SummarisationPipeline(config, scan_pool=scan_pool)
+            t0 = time.perf_counter()
+            shard = pipe.summarise_vcf("bench", str(vcf))
+            dt = time.perf_counter() - t0
+            assert shard.n_rows > 0
+            return dt, shard.meta["variant_count"]
+
+        t_local, v_local = run("local", None)
+        workers = [
+            WorkerServer(
+                VariantEngine(
+                    BeaconConfig(
+                        engine=EngineConfig(
+                            microbatch=False, use_mesh=False, use_tpu=False
+                        )
+                    )
+                ),
+                open_scan=True,  # loopback-only bench workers
+            ).start_background()
+            for _ in range(2)
+        ]
+        try:
+            pool = ScanWorkerPool([w.address for w in workers])
+            t_dist, v_dist = run("dist", pool)
+        finally:
+            for w in workers:
+                w.shutdown()
+        return {
+            "n_records": n_records,
+            "single_host_rec_per_s": round(n_records / t_local, 1),
+            "two_workers_rec_per_s": round(n_records / t_dist, 1),
+            "variant_parity": v_local == v_dist,
+        }
+
+
 def main() -> None:
     records, shard = build_corpus()
 
@@ -497,6 +569,7 @@ def main() -> None:
         "config3_bracket_chr1_22": config3_bracket_ranges(),
         "config4_multi_dataset": config4_multi_dataset(),
         "config5_sv_indel": config5_sv_indel(records, shard),
+        "config6_ingest": config6_ingest(),
     }
     print(
         json.dumps(

@@ -139,6 +139,12 @@ class IngestConfig:
     # also materialise reference-layout binary region files per VCF
     # (vcf-summaries/ portable exchange format, index/portable.py)
     export_portable: bool = True
+    # remote slice-scan workers (the reference's <=1000-lambda
+    # summariseSlice fan-out): slice jobs scatter round-robin across
+    # these worker URLs; empty = scan on this host's thread pool
+    scan_worker_urls: tuple[str, ...] = ()
+    scan_timeout_s: float = 120.0  # per-slice worker call budget
+    scan_retries: int = 1  # extra workers tried before local fallback
 
 
 # canonical external-service endpoints (reference indexer:40-42); the
@@ -235,6 +241,14 @@ class BeaconConfig:
             ),
             workers=int(env.get("BEACON_RESOLVER_WORKERS", "8")),
         )
+        ingest_over = {}
+        if "BEACON_SCAN_WORKERS" in env:
+            ingest_over["scan_worker_urls"] = tuple(
+                u.strip()
+                for u in env["BEACON_SCAN_WORKERS"].split(",")
+                if u.strip()
+            )
+        ingest = IngestConfig(**ingest_over)
         auth = AuthConfig(
             submit_token=env.get("BEACON_SUBMIT_TOKEN", ""),
             worker_token=env.get("BEACON_WORKER_TOKEN", ""),
@@ -243,6 +257,7 @@ class BeaconConfig:
             info=info,
             storage=storage,
             engine=engine,
+            ingest=ingest,
             resolvers=resolvers,
             auth=auth,
         )

@@ -522,13 +522,17 @@ def _gt_matrix(genotypes: list[str], gt_words: int):
     return M, ntok, tok1, tok2, tok_over
 
 
-def save_index(shard: VariantIndexShard, path: str | Path) -> None:
-    """Persist a shard as one compressed npz + json meta sidecar.
+_SHARD_PLANES = (
+    "gt_bits",
+    "gt_bits2",
+    "tok_bits1",
+    "tok_bits2",
+    "gt_overflow",
+    "tok_overflow",
+)
 
-    Writes are atomic (tmp + rename) so a crash mid-save can never leave a
-    truncated shard that bricks the resume path."""
-    path = Path(path)
-    path.parent.mkdir(parents=True, exist_ok=True)
+
+def _shard_arrays(shard: VariantIndexShard) -> dict:
     arrays = {f"col_{k}": v for k, v in shard.cols.items()}
     arrays["chrom_offsets"] = shard.chrom_offsets
     arrays["ref_blob"] = shard.ref_blob
@@ -536,17 +540,39 @@ def save_index(shard: VariantIndexShard, path: str | Path) -> None:
     arrays["alt_blob"] = shard.alt_blob
     arrays["alt_off"] = shard.alt_off
     arrays["vt_codes"] = shard.vt_codes
-    for plane in (
-        "gt_bits",
-        "gt_bits2",
-        "tok_bits1",
-        "tok_bits2",
-        "gt_overflow",
-        "tok_overflow",
-    ):
+    for plane in _SHARD_PLANES:
         arr = getattr(shard, plane)
         if arr is not None:
             arrays[plane] = arr
+    return arrays
+
+
+def _shard_from(data, meta: dict) -> VariantIndexShard:
+    cols = {k[4:]: data[k] for k in data.files if k.startswith("col_")}
+    return VariantIndexShard(
+        meta=meta,
+        cols=cols,
+        chrom_offsets=data["chrom_offsets"],
+        ref_blob=data["ref_blob"],
+        ref_off=data["ref_off"],
+        alt_blob=data["alt_blob"],
+        alt_off=data["alt_off"],
+        vt_codes=data["vt_codes"],
+        **{
+            plane: (data[plane] if plane in data.files else None)
+            for plane in _SHARD_PLANES
+        },
+    )
+
+
+def save_index(shard: VariantIndexShard, path: str | Path) -> None:
+    """Persist a shard as one compressed npz + json meta sidecar.
+
+    Writes are atomic (tmp + rename) so a crash mid-save can never leave a
+    truncated shard that bricks the resume path."""
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    arrays = _shard_arrays(shard)
     import os
 
     tmp = path.with_name(path.name + ".tmp.npz")
@@ -561,30 +587,52 @@ def load_index(path: str | Path) -> VariantIndexShard:
     path = Path(path)
     data = np.load(path if path.suffix == ".npz" else str(path) + ".npz")
     meta = json.loads(Path(str(path) + ".meta.json").read_text())
-    cols = {
-        k[4:]: data[k] for k in data.files if k.startswith("col_")
-    }
-    return VariantIndexShard(
-        meta=meta,
-        cols=cols,
-        chrom_offsets=data["chrom_offsets"],
-        ref_blob=data["ref_blob"],
-        ref_off=data["ref_off"],
-        alt_blob=data["alt_blob"],
-        alt_off=data["alt_off"],
-        vt_codes=data["vt_codes"],
-        **{
-            plane: (data[plane] if plane in data.files else None)
-            for plane in (
-                "gt_bits",
-                "gt_bits2",
-                "tok_bits1",
-                "tok_bits2",
-                "gt_overflow",
-                "tok_overflow",
-            )
-        },
+    return _shard_from(data, meta)
+
+
+def dumps_index(shard: VariantIndexShard) -> bytes:
+    """One self-contained npz blob (meta embedded) — the wire form slice
+    shards travel in from scan workers to the coordinator (the role S3
+    partial-result keys play for the reference's summariseSlice)."""
+    import io as _io
+
+    arrays = _shard_arrays(shard)
+    arrays["meta_json"] = np.frombuffer(
+        json.dumps(shard.meta).encode(), dtype=np.uint8
     )
+    buf = _io.BytesIO()
+    np.savez_compressed(buf, **arrays)
+    return buf.getvalue()
+
+
+def loads_index(blob: bytes) -> VariantIndexShard:
+    import io as _io
+
+    data = np.load(_io.BytesIO(blob), allow_pickle=False)
+    meta = json.loads(bytes(data["meta_json"]))
+    return _shard_from(data, meta)
+
+
+def save_index_blob(blob: bytes, path: str | Path) -> dict:
+    """Persist a ``dumps_index`` blob as a standard on-disk shard (npz +
+    meta sidecar) WITHOUT re-encoding the arrays, returning the embedded
+    meta. np.load is lazy, so only the tiny meta_json entry is inflated —
+    the coordinator never pays decompress+recompress for slice shards it
+    merely relays from scan workers to disk."""
+    import io as _io
+    import os
+
+    data = np.load(_io.BytesIO(blob), allow_pickle=False)
+    meta = json.loads(bytes(data["meta_json"]))
+    path = Path(path)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    tmp = path.with_name(path.name + ".tmp.npz")
+    tmp.write_bytes(blob)
+    os.replace(tmp, path if path.suffix == ".npz" else str(path) + ".npz")
+    meta_tmp = Path(str(path) + ".meta.json.tmp")
+    meta_tmp.write_text(json.dumps(meta))
+    os.replace(meta_tmp, str(path) + ".meta.json")
+    return meta
 
 
 def merge_shards(shards: list[VariantIndexShard]) -> VariantIndexShard:

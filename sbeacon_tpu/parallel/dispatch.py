@@ -34,7 +34,11 @@ import concurrent.futures as futures_mod
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
-from ..payloads import VariantQueryPayload, VariantSearchResponse
+from ..payloads import (
+    SliceScanPayload,
+    VariantQueryPayload,
+    VariantSearchResponse,
+)
 from ..utils.trace import span
 
 log = logging.getLogger(__name__)
@@ -43,7 +47,7 @@ log = logging.getLogger(__name__)
 # -- worker side --------------------------------------------------------------
 
 
-def _make_handler(engine, token: str = ""):
+def _make_handler(engine, token: str = "", open_scan: bool = False):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -85,9 +89,33 @@ def _make_handler(engine, token: str = ""):
             else:
                 self._send(404, {"error": "not found"})
 
+        def _send_bytes(self, status: int, body: bytes):
+            self.send_response(status)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
         def do_POST(self):
             if not self._authorized():
                 self._send(401, {"error": "unauthorized"})
+                return
+            if self.path == "/scan":
+                # /scan range-reads a CLIENT-SUPPLIED location (local path
+                # or URL) — an SSRF/arbitrary-read primitive if exposed.
+                # Secure by default: only served when a shared token gates
+                # the worker, or when the operator opted in explicitly
+                # (in-process tests, airtight private networks).
+                if not token and not open_scan:
+                    self._send(
+                        403,
+                        {
+                            "error": "scan requires a worker token "
+                            "(or --open-scan on a private network)"
+                        },
+                    )
+                    return
+                self._do_scan()
                 return
             if self.path != "/search":
                 self._send(404, {"error": "not found"})
@@ -106,6 +134,31 @@ def _make_handler(engine, token: str = ""):
                 log.exception("worker search failed")
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
+        def _do_scan(self):
+            """Ingest slice-scan leaf (the summariseSlice worker role):
+            range-read + parse + build one slice shard, returned as a raw
+            npz blob. The VCF location must be reachable from the worker
+            (shared filesystem or object-store URL)."""
+            try:
+                from ..index.columnar import build_index, dumps_index
+                from ..ingest.pipeline import read_slice_records
+
+                n = int(self.headers.get("Content-Length", 0))
+                p = SliceScanPayload(**json.loads(self.rfile.read(n)))
+                records = read_slice_records(
+                    p.vcf_location, p.vstart, p.vend
+                )
+                shard = build_index(
+                    records,
+                    dataset_id=p.dataset_id,
+                    vcf_location=p.vcf_location,
+                    sample_names=p.sample_names,
+                )
+                self._send_bytes(200, dumps_index(shard))
+            except Exception as e:
+                log.exception("worker slice scan failed")
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
     return Handler
 
 
@@ -120,10 +173,11 @@ class WorkerServer:
         port: int = 0,
         *,
         token: str = "",
+        open_scan: bool = False,
     ):
         self.engine = engine
         self.server = ThreadingHTTPServer(
-            (host, port), _make_handler(engine, token)
+            (host, port), _make_handler(engine, token, open_scan)
         )
         self.thread: threading.Thread | None = None
 
@@ -172,6 +226,108 @@ def urllib_get(
     req = urllib.request.Request(url, headers=headers or {})
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:
         return resp.status, json.loads(resp.read())
+
+
+def urllib_post_bytes(
+    url: str, doc: dict, timeout_s: float, headers: dict | None = None
+) -> tuple[int, bytes]:
+    """JSON request -> raw-bytes response (the slice-scan transport)."""
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+class ScanWorkerPool:
+    """Coordinator-side round-robin scatter of ingest slice scans.
+
+    The pipeline hands each planned slice to ``scan_blob``; failures
+    (worker down, auth, scan error) raise WorkerError and the caller
+    falls back to scanning locally — a missing worker degrades
+    throughput, never correctness (reference analogue: a failed
+    summariseSlice lambda's slice stays in the toUpdate set and is
+    re-run). A worker that fails is put on a cooldown so one wedged host
+    cannot stall every slice for a full timeout each (the dead-worker
+    exclusion the query-path scatter already has via discovery refresh).
+    """
+
+    def __init__(
+        self,
+        worker_urls: list[str],
+        *,
+        token: str = "",
+        timeout_s: float = 120.0,
+        retries: int = 1,
+        cooldown_s: float = 30.0,
+        post_bytes=urllib_post_bytes,
+    ):
+        if not worker_urls:
+            raise ValueError("ScanWorkerPool needs at least one worker URL")
+        self.worker_urls = list(worker_urls)
+        self.token = token
+        self.timeout_s = timeout_s
+        self.retries = retries
+        self.cooldown_s = cooldown_s
+        self._post_bytes = post_bytes
+        self._next = 0
+        self._dead_until: dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def _pick(self) -> str:
+        with self._lock:
+            now = time.monotonic()
+            for _ in range(len(self.worker_urls)):
+                url = self.worker_urls[self._next % len(self.worker_urls)]
+                self._next += 1
+                if self._dead_until.get(url, 0.0) <= now:
+                    return url
+            # every worker is cooling down: take the next anyway (it may
+            # have recovered; correctness is covered by local fallback)
+            url = self.worker_urls[self._next % len(self.worker_urls)]
+            self._next += 1
+            return url
+
+    def _mark_dead(self, url: str) -> None:
+        with self._lock:
+            self._dead_until[url] = time.monotonic() + self.cooldown_s
+
+    def scan_blob(self, payload: SliceScanPayload) -> bytes:
+        """One slice scan on some worker -> the shard's npz blob
+        (columnar.dumps_index form), undecoded."""
+        doc = json.loads(payload.dumps())
+        headers = (
+            {"Authorization": f"Bearer {self.token}"} if self.token else None
+        )
+        last: Exception | None = None
+        for _attempt in range(self.retries + 1):
+            url = self._pick()
+            try:
+                status, body = self._post_bytes(
+                    f"{url}/scan", doc, self.timeout_s, headers
+                )
+            except Exception as e:
+                last = WorkerError(f"{url}: {e}")
+                self._mark_dead(url)
+                continue
+            if status == 200:
+                return body
+            last = WorkerError(f"{url}: http {status}: {body[:200]!r}")
+            if status in (401, 403):
+                self._mark_dead(url)
+        raise last
+
+    def scan(self, payload: SliceScanPayload):
+        """One slice scan on some worker -> VariantIndexShard."""
+        from ..index.columnar import loads_index
+
+        return loads_index(self.scan_blob(payload))
 
 
 class WorkerError(RuntimeError):
@@ -449,8 +605,15 @@ def main(argv: list[str] | None = None) -> None:
     p.add_argument(
         "--token",
         default=None,
-        help="shared bearer token required on /search and /datasets "
-        "(default: BEACON_WORKER_TOKEN env)",
+        help="shared bearer token required on /search, /datasets and "
+        "/scan (default: BEACON_WORKER_TOKEN env)",
+    )
+    p.add_argument(
+        "--open-scan",
+        action="store_true",
+        help="serve /scan without a token (DANGEROUS: /scan reads "
+        "arbitrary client-supplied locations; only on airtight private "
+        "networks)",
     )
     args = p.parse_args(argv)
 
@@ -458,7 +621,13 @@ def main(argv: list[str] | None = None) -> None:
     token = args.token if args.token is not None else config.auth.worker_token
     engine = VariantEngine(config)
     n = IngestService(config, engine=engine).load_all()
-    worker = WorkerServer(engine, host=args.host, port=args.port, token=token)
+    worker = WorkerServer(
+        engine,
+        host=args.host,
+        port=args.port,
+        token=token,
+        open_scan=args.open_scan,
+    )
     print(
         f"worker serving on {args.host}:{args.port} ({n} shards, "
         f"datasets: {', '.join(engine.datasets()) or 'none'})"

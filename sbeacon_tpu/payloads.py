@@ -84,3 +84,29 @@ class VariantSearchResponse:
     @staticmethod
     def loads(s: str) -> "VariantSearchResponse":
         return VariantSearchResponse(**json.loads(s))
+
+
+@dataclass
+class SliceScanPayload:
+    """One ingest slice-scan job for a remote worker.
+
+    The reference fans each VCF's virtual-offset slices to <=1000
+    summariseSlice lambdas over SNS (reference: summariseVcf/
+    lambda_function.py:217-229 publish_slice_updates; summariseSlice/
+    main.cpp:440-467). Here the same unit of work crosses the worker HTTP
+    boundary: the worker range-reads [vstart, vend) of ``vcf_location``
+    (local shared path or object-store URL), builds the slice's index
+    shard, and returns it as one npz blob (columnar.dumps_index)."""
+
+    dataset_id: str = ""
+    vcf_location: str = ""
+    vstart: int = 0
+    vend: int = 0
+    sample_names: list[str] = field(default_factory=list)
+
+    def dumps(self) -> str:
+        return json.dumps(dataclasses.asdict(self))
+
+    @staticmethod
+    def loads(s: str) -> "SliceScanPayload":
+        return SliceScanPayload(**json.loads(s))

@@ -1,0 +1,262 @@
+"""Distributed ingest: slice-scan jobs scattered across worker hosts
+(VERDICT r1 missing #4 — reference: summariseVcf fans <=1000
+summariseSlice lambdas, lambda_function.py:197-229). Correctness bar:
+multi-worker ingest produces a bit-identical index to the single-host
+path, and worker failure degrades to local scanning, never to wrong or
+missing data.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu.config import (
+    AuthConfig,
+    BeaconConfig,
+    EngineConfig,
+    IngestConfig,
+    StorageConfig,
+)
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.genomics.tabix import ensure_index
+from sbeacon_tpu.genomics.vcf import write_vcf
+from sbeacon_tpu.ingest.pipeline import SummarisationPipeline
+from sbeacon_tpu.parallel.dispatch import (
+    ScanWorkerPool,
+    WorkerError,
+    WorkerServer,
+    urllib_post_bytes,
+)
+from sbeacon_tpu.payloads import SliceScanPayload
+from sbeacon_tpu.testing import random_records
+
+SAMPLES = ["S0", "S1", "S2"]
+
+
+def _worker(token: str = "", open_scan: bool | None = None):
+    eng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    if open_scan is None:
+        open_scan = not token  # tests without tokens opt in explicitly
+    return WorkerServer(
+        eng, token=token, open_scan=open_scan
+    ).start_background()
+
+
+@pytest.fixture(scope="module")
+def vcf(tmp_path_factory):
+    root = tmp_path_factory.mktemp("divcf")
+    rng = random.Random(31)
+    recs = random_records(
+        rng, chrom="5", n=5000, n_samples=len(SAMPLES), spacing=400
+    )
+    path = root / "big.vcf.gz"
+    write_vcf(path, recs, sample_names=SAMPLES)
+    ensure_index(path)
+    return path, recs
+
+
+def _pipeline(tmp_path, name, *, scan_pool=None):
+    ingest = IngestConfig(
+        # tiny slice budget to force multiple slices on a small file
+        min_task_time=1e-6,
+        scan_rate=1e6,
+        dispatch_cost=1e-7,
+        max_concurrency=1000,
+        workers=4,
+    )
+    config = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / name), ingest=ingest
+    )
+    config.storage.ensure()
+    return SummarisationPipeline(config, scan_pool=scan_pool)
+
+
+def _assert_shards_identical(a, b):
+    assert a.n_rows == b.n_rows
+    for k in a.cols:
+        np.testing.assert_array_equal(a.cols[k], b.cols[k], err_msg=k)
+    np.testing.assert_array_equal(a.chrom_offsets, b.chrom_offsets)
+    np.testing.assert_array_equal(a.ref_blob, b.ref_blob)
+    np.testing.assert_array_equal(a.alt_blob, b.alt_blob)
+    assert a.meta["variant_count"] == b.meta["variant_count"]
+    assert a.meta["call_count"] == b.meta["call_count"]
+
+
+def test_scan_payload_roundtrip():
+    p = SliceScanPayload(
+        dataset_id="d", vcf_location="v", vstart=1, vend=2,
+        sample_names=["a"],
+    )
+    assert SliceScanPayload.loads(p.dumps()) == p
+
+
+def test_multi_worker_ingest_bit_identical(vcf, tmp_path):
+    path, _ = vcf
+    w1, w2 = _worker(), _worker()
+    try:
+        pool = ScanWorkerPool([w1.address, w2.address])
+        dist = _pipeline(tmp_path, "dist", scan_pool=pool)
+        local = _pipeline(tmp_path, "local")
+        shard_d = dist.summarise_vcf("ds", str(path))
+        shard_l = local.summarise_vcf("ds", str(path))
+        _assert_shards_identical(shard_d, shard_l)
+        # the scatter really fanned out: round-robin advanced past 1 job
+        assert pool._next > 1
+    finally:
+        w1.shutdown()
+        w2.shutdown()
+
+
+def test_dead_worker_falls_back_to_local(vcf, tmp_path):
+    path, _ = vcf
+    pool = ScanWorkerPool(["http://127.0.0.1:9"], retries=0, timeout_s=2)
+    dist = _pipeline(tmp_path, "deadw", scan_pool=pool)
+    local = _pipeline(tmp_path, "localw")
+    shard_d = dist.summarise_vcf("ds", str(path))
+    shard_l = local.summarise_vcf("ds", str(path))
+    _assert_shards_identical(shard_d, shard_l)
+
+
+def test_mixed_dead_and_live_workers(vcf, tmp_path):
+    path, _ = vcf
+    w = _worker()
+    try:
+        pool = ScanWorkerPool(
+            ["http://127.0.0.1:9", w.address], retries=1, timeout_s=2
+        )
+        dist = _pipeline(tmp_path, "mixed", scan_pool=pool)
+        local = _pipeline(tmp_path, "mixedl")
+        _assert_shards_identical(
+            dist.summarise_vcf("ds", str(path)),
+            local.summarise_vcf("ds", str(path)),
+        )
+    finally:
+        w.shutdown()
+
+
+def test_scan_endpoint_token_gated(vcf):
+    path, _ = vcf
+    w = _worker(token="tok")
+    try:
+        payload = SliceScanPayload(
+            dataset_id="d",
+            vcf_location=str(path),
+            vstart=0,
+            vend=1 << 40,
+            sample_names=SAMPLES,
+        )
+        import json
+
+        status, _body = urllib_post_bytes(
+            f"{w.address}/scan", json.loads(payload.dumps()), 10
+        )
+        assert status == 401
+        pool = ScanWorkerPool([w.address], token="tok")
+        shard = pool.scan(payload)
+        assert shard.n_rows > 0
+        bad = ScanWorkerPool([w.address], token="nope", retries=0)
+        with pytest.raises(WorkerError):
+            bad.scan(payload)
+    finally:
+        w.shutdown()
+
+
+def test_workers_scan_remote_vcf(vcf, tmp_path):
+    """Workers can range-read the VCF from an object store themselves —
+    the coordinator ships only the URL + offsets (the reference shape:
+    every summariseSlice lambda pulls its own S3 range)."""
+    from sbeacon_tpu.testing import range_server
+
+    path, _ = vcf
+    w = _worker()
+    try:
+        with range_server(path.parent) as base:
+            url = f"{base}/{path.name}"
+            pool = ScanWorkerPool([w.address])
+            dist = _pipeline(tmp_path, "rdist", scan_pool=pool)
+            local = _pipeline(tmp_path, "rlocal")
+            _assert_shards_identical(
+                dist.summarise_vcf("ds", url),
+                local.summarise_vcf("ds", str(path)),
+            )
+    finally:
+        w.shutdown()
+
+
+def test_config_env_scan_workers(monkeypatch):
+    monkeypatch.setenv(
+        "BEACON_SCAN_WORKERS", "http://a:1, http://b:2"
+    )
+    cfg = BeaconConfig.from_env()
+    assert cfg.ingest.scan_worker_urls == ("http://a:1", "http://b:2")
+
+
+def test_pipeline_builds_pool_from_config(vcf, tmp_path):
+    path, _ = vcf
+    w = _worker(token="t2")
+    try:
+        config = BeaconConfig(
+            storage=StorageConfig(root=tmp_path / "cfg"),
+            ingest=IngestConfig(scan_worker_urls=(w.address,)),
+            auth=AuthConfig(worker_token="t2"),
+        )
+        config.storage.ensure()
+        pipe = SummarisationPipeline(config)
+        assert pipe.scan_pool is not None
+        shard = pipe.summarise_vcf("ds", str(path))
+        assert shard.n_rows > 0
+    finally:
+        w.shutdown()
+
+
+def test_scan_refused_without_token_or_opt_in(vcf):
+    """Secure default: /scan is an arbitrary-location read primitive, so
+    an un-tokened worker refuses it unless the operator opted in."""
+    import json
+
+    path, _ = vcf
+    w = _worker(open_scan=False)
+    try:
+        payload = SliceScanPayload(
+            dataset_id="d", vcf_location=str(path),
+            vstart=0, vend=1 << 40, sample_names=SAMPLES,
+        )
+        status, body = urllib_post_bytes(
+            f"{w.address}/scan", json.loads(payload.dumps()), 10
+        )
+        assert status == 403
+        assert b"token" in body
+        # the query surface stays available
+        from sbeacon_tpu.parallel.dispatch import urllib_get
+
+        status, doc = urllib_get(f"{w.address}/datasets", 5)
+        assert status == 200
+    finally:
+        w.shutdown()
+
+
+def test_cooldown_skips_failing_worker(vcf):
+    """After a failure the wedged worker is excluded for cooldown_s, so
+    subsequent scans go straight to healthy workers."""
+    path, _ = vcf
+    w = _worker()
+    try:
+        pool = ScanWorkerPool(
+            ["http://127.0.0.1:9", w.address],
+            retries=1,
+            timeout_s=2,
+            cooldown_s=60,
+        )
+        payload = SliceScanPayload(
+            dataset_id="d", vcf_location=str(path),
+            vstart=0, vend=1 << 40, sample_names=SAMPLES,
+        )
+        pool.scan(payload)  # first call burns the dead worker + marks it
+        assert pool._dead_until.get("http://127.0.0.1:9", 0) > 0
+        picks = {pool._pick() for _ in range(4)}
+        assert picks == {w.address}
+    finally:
+        w.shutdown()
