@@ -241,6 +241,26 @@ class BeaconApp:
         if not parts or parts == ["info"]:
             return 200, info_response(info)
         head = parts[0]
+        if head == "schemas":
+            # served per-entity default model schemas (the reference
+            # vendors these as shared_resources/schemas/ JSON documents;
+            # here /map, /entry_types and returnedSchemas point at THIS
+            # beacon's resolvable copies — api/model_schemas.py)
+            from .model_schemas import ENTITY_SCHEMAS, schema_url
+
+            if len(parts) == 1:
+                return 200, {
+                    "entityTypes": sorted(ENTITY_SCHEMAS),
+                    "schemas": {
+                        e: schema_url(info.uri, e)
+                        for e in sorted(ENTITY_SCHEMAS)
+                    },
+                }
+            if len(parts) == 2 and parts[1] in ENTITY_SCHEMAS:
+                return 200, ENTITY_SCHEMAS[parts[1]]
+            return 404, self.env.error(
+                404, f"unknown schema /{'/'.join(parts[1:])}"
+            )
         if len(parts) == 1:
             if head == "_trace":
                 # debug-only profiling surface; 404s unless tracing is on

@@ -67,6 +67,28 @@ class Envelopes:
             },
         }
 
+    def _entity_schemas(self, set_type: str) -> list | None:
+        """returnedSchemas entries pointing at the served per-entity
+        default model schema (api/model_schemas.py), so record responses
+        reference resolvable documents."""
+        from .model_schemas import (
+            ENTITY_SCHEMAS,
+            PATH_TO_ENTITY,
+            schema_url,
+        )
+
+        # setType values mix singular/plural (app._SET_TYPE); the path
+        # table is the single normalisation source
+        entity = PATH_TO_ENTITY.get(set_type, set_type)
+        if entity not in ENTITY_SCHEMAS:
+            return None
+        return [
+            {
+                "entityType": entity,
+                "schema": schema_url(self.info.uri, entity),
+            }
+        ]
+
     def result_sets(
         self,
         *,
@@ -88,6 +110,7 @@ class Envelopes:
             "meta": self._meta(
                 granularity="record",
                 pagination={"skip": skip, "limit": limit},
+                schemas=self._entity_schemas(set_type),
             ),
             "response": {
                 "resultSets": [

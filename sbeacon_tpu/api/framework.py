@@ -94,23 +94,29 @@ _SUB_ENDPOINTS: dict[str, list[str]] = {
 }
 
 
-def _default_schema(entry_id: str) -> dict:
+def _default_schema(entry_id: str, base_uri: str | None = None) -> dict:
+    """Entry-type default schema descriptor pointing at THIS beacon's
+    served schema document (/schemas/{entityType} — api/model_schemas.py),
+    so returned schema references resolve without reaching external model
+    repositories."""
+    from .model_schemas import schema_url
+
     info = ENTRY_TYPES[entry_id]
     return {
         "id": f"ga4gh-beacon-{entry_id.lower()}-v2.0.0",
         "name": f"Default schema for {info['name'].lower()}",
-        "referenceToSchemaDefinition": (
-            f"{_MODEL_URL}/{info['path']}/defaultSchema.json"
+        "referenceToSchemaDefinition": schema_url(
+            base_uri or "", entry_id
         ),
         "schemaVersion": "v2.0.0",
     }
 
 
-def _entry_type_descriptor(entry_id: str) -> dict:
+def _entry_type_descriptor(entry_id: str, base_uri: str = "") -> dict:
     info = ENTRY_TYPES[entry_id]
     desc = {
         "additionallySupportedSchemas": [],
-        "defaultSchema": _default_schema(entry_id),
+        "defaultSchema": _default_schema(entry_id, base_uri),
         "description": info["description"],
         "id": entry_id,
         "name": info["name"],
@@ -181,7 +187,7 @@ def entry_types_response(info: BeaconInfo) -> dict:
         "meta": _framework_meta(info),
         "response": {
             "entryTypes": {
-                eid: _entry_type_descriptor(eid) for eid in ENTRY_TYPES
+                eid: _entry_type_descriptor(eid, info.uri) for eid in ENTRY_TYPES
             }
         },
     }
@@ -196,7 +202,7 @@ def configuration_response(info: BeaconInfo) -> dict:
         "response": {
             "$schema": SCHEMA,
             "entryTypes": {
-                eid: _entry_type_descriptor(eid) for eid in ENTRY_TYPES
+                eid: _entry_type_descriptor(eid, info.uri) for eid in ENTRY_TYPES
             },
             "maturityAttributes": {"productionStatus": "DEV"},
             "securityAttributes": {

@@ -62,6 +62,62 @@ def validate_submission(body: dict, *, update: bool) -> None:
         )
 
 
+PAYLOAD_REF_SCHEMA = {
+    "type": "object",
+    "properties": {"payloadRef": {"type": "string", "minLength": 1}},
+    "required": ["payloadRef"],
+    "additionalProperties": False,
+}
+
+#: ceiling for dereferenced submission payloads (a wrong/hostile ref must
+#: not OOM the server); generous vs the reference's motivating limit (API
+#: Gateway's ~10 MB request cap is WHY s3Payload exists)
+MAX_PAYLOAD_REF_BYTES = 512 * 1024 * 1024
+
+
+def resolve_payload_ref(body: dict) -> dict:
+    """``{"payloadRef": "<file path or URL>"}`` -> the real submission.
+
+    The reference accepts ``s3Payload`` bodies pointing at an S3 object so
+    submissions can exceed the API gateway's request-size cap (reference:
+    submitDataset/lambda_function.py:278-282). The equivalent here is a
+    local path or object-store URL (http(s)/s3 via sbeacon_tpu.io)
+    holding the JSON document."""
+    import json
+
+    from ..io import is_remote, open_source
+
+    ref = body["payloadRef"]
+    try:
+        # remote refs get a hard byte budget BEFORE any body is read — a
+        # hostile Range-less server must not stream past the cap
+        src = (
+            open_source(ref, max_object_bytes=MAX_PAYLOAD_REF_BYTES)
+            if is_remote(ref)
+            else open_source(ref)
+        )
+        n = src.size()
+        if n > MAX_PAYLOAD_REF_BYTES:
+            raise RequestError(
+                f"payloadRef object is {n} bytes "
+                f"(limit {MAX_PAYLOAD_REF_BYTES})"
+            )
+        raw = src.read_range(0, n, workers=4)
+    except RequestError:
+        raise
+    except Exception as e:
+        raise RequestError(f"could not read payloadRef {ref}: {e}")
+    try:
+        doc = json.loads(raw)
+    except ValueError as e:
+        raise RequestError(f"payloadRef {ref} is not valid JSON: {e}")
+    if not isinstance(doc, dict):
+        raise RequestError(f"payloadRef {ref} must hold a JSON object")
+    if "payloadRef" in doc:
+        raise RequestError("payloadRef must not nest another payloadRef")
+    return doc
+
+
 def submit_dataset(
     app,
     body: dict,
@@ -71,6 +127,18 @@ def submit_dataset(
     """Validate and ingest one submission; returns the progress summary."""
     if not isinstance(body, dict):
         raise RequestError("body must be a JSON object")
+    if "payloadRef" in body:
+        # large-body indirection (the reference's s3Payload form): the
+        # inline body is only the pointer; the real submission is
+        # fetched, then validated exactly like an inline one
+        ref_errors = list(
+            jsonschema.Draft7Validator(PAYLOAD_REF_SCHEMA).iter_errors(body)
+        )
+        if ref_errors:
+            raise RequestError(
+                "; ".join(e.message for e in ref_errors[:5])
+            )
+        body = resolve_payload_ref(body)
     validate_submission(body, update=update)
 
     dataset_id = body["datasetId"]

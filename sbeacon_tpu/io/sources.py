@@ -126,6 +126,7 @@ class HttpRangeSource(ByteSource):
         retries: int = 3,
         timeout_s: float = 60.0,
         chunk_bytes: int = 8 * 1024 * 1024,
+        max_object_bytes: int | None = None,
     ):
         self.location = url
         if url.startswith("s3://"):
@@ -136,8 +137,28 @@ class HttpRangeSource(ByteSource):
         self._retries = retries
         self._timeout_s = timeout_s
         self._chunk_bytes = chunk_bytes
+        # budget for whole-body reads (Range-less servers): a hostile or
+        # misconfigured endpoint streaming an unbounded 200 body must be
+        # cut off at the cap, not read into memory first
+        self._max_object_bytes = max_object_bytes
         self._size: int | None = None
         self._whole: bytes | None = None  # cache when Range is unsupported
+
+    def _read_capped(self, resp) -> bytes:
+        cap = self._max_object_bytes
+        if cap is None:
+            return resp.read()
+        cl = resp.headers.get("Content-Length")
+        if cl and cl.isdigit() and int(cl) > cap:
+            raise RemoteIOError(
+                f"{self.location}: object is {cl} bytes (limit {cap})"
+            )
+        body = resp.read(cap + 1)
+        if len(body) > cap:
+            raise RemoteIOError(
+                f"{self.location}: object exceeds {cap} bytes"
+            )
+        return body
 
     # -- low-level ----------------------------------------------------------
 
@@ -195,10 +216,10 @@ class HttpRangeSource(ByteSource):
                     # fall through to a plain full GET below
                 else:
                     # 200: server ignored Range — body is the whole object
-                    body = resp.read()
+                    body = self._read_capped(resp)
                     return len(body), body
             with self._request({}) as resp:
-                body = resp.read()
+                body = self._read_capped(resp)
                 return len(body), body
 
         n, body = self._with_retries(probe)
@@ -211,10 +232,10 @@ class HttpRangeSource(ByteSource):
         def fetch():
             hdr = {"Range": f"bytes={start}-{end - 1}"}
             with self._request(hdr) as resp:
-                body = resp.read()
                 if resp.status == 206:
-                    return body
+                    return resp.read()
                 # 200: server ignored Range — body is the whole object
+                body = self._read_capped(resp)
                 self._whole = body
                 self._size = len(body)
                 return body[start:end]
