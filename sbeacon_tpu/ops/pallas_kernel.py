@@ -475,6 +475,24 @@ def _plan_groups(
     silently truncated — oversize windows report overflow instead.
     """
     order = np.argsort(lo, kind="stable")
+
+    # vectorized fast path: fixed G-sized groups in sorted order. Valid
+    # whenever every query's capped window fits its group's tile span —
+    # true for dense batches (the serving hot path), where the Python
+    # greedy loop below would otherwise be ~10 ms of GIL-bound host work
+    # per 10k-query batch, throttling pipelined throughput.
+    b = len(order)
+    pad = (-b) % g
+    slots_v = np.concatenate([order, np.repeat(order[-1:], pad)])
+    ng = len(slots_v) // g
+    lo_s = lo[slots_v].reshape(ng, g)
+    need_end = np.minimum(hi, lo + cap)[slots_v].reshape(ng, g)
+    t0 = lo_s[:, 0] // W
+    if (need_end <= ((t0 + 2) * W)[:, None]).all():
+        return slots_v.astype(np.int64), t0.astype(np.int32)
+
+    # sparse/straggler batches: exact greedy packing (splits a group as
+    # soon as the next query cannot share its tile span)
     slots: list[int] = []
     starts: list[int] = []
     cur: list[int] = []
@@ -490,8 +508,8 @@ def _plan_groups(
 
     for qi in order:
         qi = int(qi)
-        need_end = min(int(hi[qi]), int(lo[qi]) + cap)
-        if cur and (len(cur) == g or need_end > (cur_t0 + 2) * W):
+        need = min(int(hi[qi]), int(lo[qi]) + cap)
+        if cur and (len(cur) == g or need > (cur_t0 + 2) * W):
             close()
         if not cur:
             cur_t0 = int(lo[qi]) // W

@@ -47,7 +47,9 @@ log = logging.getLogger(__name__)
 # -- worker side --------------------------------------------------------------
 
 
-def _make_handler(engine, token: str = "", open_scan: bool = False):
+def _make_handler(
+    engine, token: str = "", open_scan: bool = False, reload_fn=None
+):
     class Handler(BaseHTTPRequestHandler):
         def log_message(self, *a):  # quiet
             pass
@@ -99,6 +101,20 @@ def _make_handler(engine, token: str = "", open_scan: bool = False):
         def do_POST(self):
             if not self._authorized():
                 self._send(401, {"error": "unauthorized"})
+                return
+            if self.path == "/reload":
+                # re-pin shards from storage (a coordinator that ingested
+                # into shared storage tells workers to pick the new
+                # shards up without a process restart)
+                if reload_fn is None:
+                    self._send(404, {"error": "reload not wired"})
+                    return
+                try:
+                    n = reload_fn()
+                    self._send(200, {"ok": True, "shards": int(n)})
+                except Exception as e:
+                    log.exception("worker reload failed")
+                    self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
             if self.path == "/scan":
                 # /scan range-reads a CLIENT-SUPPLIED location (local path
@@ -174,10 +190,12 @@ class WorkerServer:
         *,
         token: str = "",
         open_scan: bool = False,
+        reload_fn=None,
     ):
         self.engine = engine
         self.server = ThreadingHTTPServer(
-            (host, port), _make_handler(engine, token, open_scan)
+            (host, port),
+            _make_handler(engine, token, open_scan, reload_fn),
         )
         self.thread: threading.Thread | None = None
 
@@ -620,13 +638,15 @@ def main(argv: list[str] | None = None) -> None:
     config = BeaconConfig.from_env(args.data_root)
     token = args.token if args.token is not None else config.auth.worker_token
     engine = VariantEngine(config)
-    n = IngestService(config, engine=engine).load_all()
+    service = IngestService(config, engine=engine)
+    n = service.load_all()
     worker = WorkerServer(
         engine,
         host=args.host,
         port=args.port,
         token=token,
         open_scan=args.open_scan,
+        reload_fn=service.load_all,
     )
     print(
         f"worker serving on {args.host}:{args.port} ({n} shards, "

@@ -260,3 +260,40 @@ def test_cooldown_skips_failing_worker(vcf):
         assert picks == {w.address}
     finally:
         w.shutdown()
+
+
+def test_worker_reload_pins_new_shards(vcf, tmp_path):
+    """Shared-storage serving: after the coordinator ingests into the
+    worker's data root, POST /reload re-pins the new shards without a
+    process restart (the compose topology's wiring)."""
+    import json
+
+    from sbeacon_tpu.ingest import IngestService
+    from sbeacon_tpu.parallel.dispatch import urllib_post
+
+    path, _ = vcf
+    root = tmp_path / "shared"
+    config = BeaconConfig(storage=StorageConfig(root=root))
+    config.storage.ensure()
+    eng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    service = IngestService(config, engine=eng)
+    w = WorkerServer(
+        eng, token="rt", reload_fn=service.load_all
+    ).start_background()
+    try:
+        assert eng.datasets() == []
+        # a separate pipeline (the coordinator's role) ingests into the
+        # same storage root
+        other = SummarisationPipeline(config)
+        other.summarise_vcf("dsNew", str(path))
+        hdr = {"Authorization": "Bearer rt"}
+        status, doc = urllib_post(f"{w.address}/reload", {}, 30, hdr)
+        assert status == 200 and doc["ok"] and doc["shards"] >= 1
+        assert eng.datasets() == ["dsNew"]
+        # token gated like every worker route
+        status, _doc = urllib_post(f"{w.address}/reload", {}, 10)
+        assert status == 401
+    finally:
+        w.shutdown()
