@@ -323,6 +323,14 @@ class SummarisationPipeline:
             call_count=call_count,
             sample_count=sample_count,
         )
+        if self.scan_pool is not None:
+            # shared-storage fleets: tell scan workers to re-pin the
+            # newly persisted shards so the query fan-out serves them
+            # immediately (best-effort; workers also reload on restart)
+            try:
+                self.scan_pool.reload_workers()
+            except Exception:
+                log.warning("worker reload after ingest failed", exc_info=True)
         return {
             "datasetId": dataset_id,
             "variantCount": distinct,

@@ -316,13 +316,16 @@ class ScanWorkerPool:
         with self._lock:
             self._dead_until[url] = time.monotonic() + self.cooldown_s
 
+    def _auth_headers(self) -> dict | None:
+        return (
+            {"Authorization": f"Bearer {self.token}"} if self.token else None
+        )
+
     def scan_blob(self, payload: SliceScanPayload) -> bytes:
         """One slice scan on some worker -> the shard's npz blob
         (columnar.dumps_index form), undecoded."""
         doc = json.loads(payload.dumps())
-        headers = (
-            {"Authorization": f"Bearer {self.token}"} if self.token else None
-        )
+        headers = self._auth_headers()
         last: Exception | None = None
         for _attempt in range(self.retries + 1):
             url = self._pick()
@@ -346,6 +349,49 @@ class ScanWorkerPool:
         from ..index.columnar import loads_index
 
         return loads_index(self.scan_blob(payload))
+
+    #: reload is a tiny control message — never let it inherit the
+    #: (possibly minutes-long) slice-scan timeout
+    RELOAD_TIMEOUT_S = 10.0
+
+    def reload_workers(self, *, post=urllib_post) -> int:
+        """Best-effort concurrent POST /reload to every worker
+        (shared-storage fleets re-pin freshly ingested shards without a
+        restart); returns how many workers acknowledged. Concurrent with
+        a short timeout so one wedged worker cannot stall ingest
+        completion, and non-200 answers (404 = reload_fn not wired,
+        500 = reload failed) are logged — a fleet silently serving stale
+        shards is exactly the failure this call exists to prevent."""
+        headers = self._auth_headers()
+
+        def one(url: str) -> bool:
+            try:
+                status, doc = post(
+                    f"{url}/reload", {}, self.RELOAD_TIMEOUT_S, headers
+                )
+            except Exception:
+                log.warning("worker %s reload failed", url, exc_info=True)
+                return False
+            if status != 200:
+                log.warning(
+                    "worker %s reload answered http %s: %s",
+                    url,
+                    status,
+                    doc,
+                )
+                return False
+            return True
+
+        with ThreadPoolExecutor(min(8, len(self.worker_urls))) as pool:
+            ok = sum(pool.map(one, self.worker_urls))
+        if ok < len(self.worker_urls):
+            log.warning(
+                "only %d/%d workers reloaded; the others serve stale "
+                "shards until their next reload/restart",
+                ok,
+                len(self.worker_urls),
+            )
+        return ok
 
 
 class WorkerError(RuntimeError):

@@ -297,3 +297,30 @@ def test_worker_reload_pins_new_shards(vcf, tmp_path):
         assert status == 401
     finally:
         w.shutdown()
+
+
+def test_pipeline_auto_reloads_workers_after_ingest(vcf, tmp_path):
+    """summarise_dataset ends by telling scan workers to re-pin shards
+    from shared storage, so the query fan-out serves the new dataset
+    without operator action."""
+    from sbeacon_tpu.ingest import IngestService
+
+    path, _ = vcf
+    root = tmp_path / "sharedauto"
+    config = BeaconConfig(storage=StorageConfig(root=root))
+    config.storage.ensure()
+    weng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    svc = IngestService(config, engine=weng)
+    w = WorkerServer(
+        weng, token="rt", open_scan=False, reload_fn=svc.load_all
+    ).start_background()
+    try:
+        pool = ScanWorkerPool([w.address], token="rt")
+        pipe = SummarisationPipeline(config, scan_pool=pool)
+        assert weng.datasets() == []
+        pipe.summarise_dataset("dsAuto", [str(path)])
+        assert weng.datasets() == ["dsAuto"]
+    finally:
+        w.shutdown()
