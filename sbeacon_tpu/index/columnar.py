@@ -292,6 +292,24 @@ def build_index(
     row_rec = np.zeros(n, dtype=np.int32)
     row_allele = np.zeros(n, dtype=np.int32)
 
+    # per-build memoization: cohort alleles repeat massively (refs are
+    # mostly single bases), so hashing/prefix-packing per UNIQUE string
+    # instead of per row removes the loop's main Python cost
+    hash_cache: dict[str, int] = {}
+    prefix_cache: dict[str, np.ndarray] = {}
+
+    def allele_hash(s: str) -> int:
+        h = hash_cache.get(s)
+        if h is None:
+            h = hash_cache[s] = fnv1a32(s.upper().encode())
+        return h
+
+    def alt_prefix_of(s: str) -> np.ndarray:
+        p = prefix_cache.get(s)
+        if p is None:
+            p = prefix_cache[s] = pack_prefix16(s.encode())
+        return p
+
     for i, (code, pos, rec_ord, alt_ord, rec) in enumerate(rows):
         alt = rec.alts[alt_ord]
         ref = rec.ref
@@ -304,8 +322,8 @@ def build_index(
         cols["rec_end"][i] = pos + len(ref) - 1
         cols["ref_len"][i] = len(ref)
         cols["alt_len"][i] = len(alt)
-        cols["ref_hash"][i] = fnv1a32(ref.upper().encode())
-        cols["alt_hash"][i] = fnv1a32(alt.upper().encode())
+        cols["ref_hash"][i] = allele_hash(ref)
+        cols["alt_hash"][i] = allele_hash(alt)
         cols["ref_repeat_k"][i] = _ref_repeat_k(ref, alt)
         cols["flags"][i] = (
             _alt_flags(alt)
@@ -315,7 +333,7 @@ def build_index(
         cols["ac"][i] = ac_cache[rec_ord][alt_ord]
         cols["an"][i] = an_cache[rec_ord]
         cols["rec_id"][i] = rec_renumber[rec_ord]
-        alt_prefix[i] = pack_prefix16(alt.encode())
+        alt_prefix[i] = alt_prefix_of(alt)
         if rec.vt not in vt_index:
             vt_index[rec.vt] = len(vt_vocab)
             vt_vocab.append(rec.vt)

@@ -186,11 +186,17 @@ def export_region_files(
     *,
     max_gap: int = MAX_SLICE_GAP,
     max_raw_bytes: int = MAX_FILE_RAW_BYTES,
-    level: int = 9,
+    level: int = 6,
 ) -> list[Path]:
     """Write the shard as reference-layout region files:
     ``contig/{chrom}/{escaped-location}/regions/{start}-{end}-{rawsize}``,
-    new file at every >max_gap position gap or raw-size ceiling."""
+    new file at every >max_gap position gap or raw-size ceiling.
+
+    ``level`` is zlib's standard default (6): exports were ~20% of ingest
+    wall time at level 9 for low-single-digit % smaller files, and the
+    wire format (and the {rawsize} suffix, which counts PRE-compression
+    bytes) is identical at any level — importers never see the difference.
+    """
     out_dir = Path(out_dir)
     location = _escape_location(shard.meta.get("vcf_location", "unknown"))
     pos = shard.cols["pos"]
@@ -215,6 +221,16 @@ def export_region_files(
     def row_alt_b(i: int) -> bytes:
         return alt_blob[alt_off[i] : alt_off[i + 1]]
 
+    # packed_len memoized per unique allele across ALL chromosomes —
+    # cohorts repeat the same handful of alleles massively
+    plen_cache: dict[bytes, int] = {}
+
+    def plen(b: bytes) -> int:
+        v = plen_cache.get(b)
+        if v is None:
+            v = plen_cache[b] = packed_len(b)
+        return v
+
     for chrom, code in CHROMOSOME_CODES.items():
         lo = int(shard.chrom_offsets[code])
         hi = int(shard.chrom_offsets[code + 1])
@@ -227,7 +243,7 @@ def export_region_files(
         # write_data_to_s3.h bufferLength)
         rec_raw = np.asarray(
             [
-                10 + packed_len(row_ref_b(i)) + 1 + packed_len(row_alt_b(i))
+                10 + plen(row_ref_b(i)) + 1 + plen(row_alt_b(i))
                 for i in range(lo, hi)
             ],
             dtype=np.int64,
