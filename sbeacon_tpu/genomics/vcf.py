@@ -115,7 +115,11 @@ def parse_record(line: str | bytes) -> VcfRecord | None:
             gt_idx = fmt.index("GT")
         except ValueError:
             gt_idx = -1
-        if gt_idx >= 0:
+        if gt_idx == 0:
+            # GT-first is the overwhelmingly common FORMAT layout;
+            # partition beats a full split across every sample column
+            genotypes = [s.partition(":")[0] for s in fields[9:]]
+        elif gt_idx > 0:
             for sample in fields[9:]:
                 parts = sample.split(":")
                 genotypes.append(parts[gt_idx] if gt_idx < len(parts) else ".")

@@ -292,23 +292,16 @@ def build_index(
     row_rec = np.zeros(n, dtype=np.int32)
     row_allele = np.zeros(n, dtype=np.int32)
 
-    # per-build memoization: cohort alleles repeat massively (refs are
-    # mostly single bases), so hashing/prefix-packing per UNIQUE string
-    # instead of per row removes the loop's main Python cost
-    hash_cache: dict[str, int] = {}
-    prefix_cache: dict[str, np.ndarray] = {}
+    # per-build memoization (functools.cache scoped to this call):
+    # cohort alleles repeat massively (refs are mostly single bases), so
+    # hashing/prefix-packing per UNIQUE string instead of per row
+    # removes the loop's main Python cost
+    import functools
 
-    def allele_hash(s: str) -> int:
-        h = hash_cache.get(s)
-        if h is None:
-            h = hash_cache[s] = fnv1a32(s.upper().encode())
-        return h
-
-    def alt_prefix_of(s: str) -> np.ndarray:
-        p = prefix_cache.get(s)
-        if p is None:
-            p = prefix_cache[s] = pack_prefix16(s.encode())
-        return p
+    allele_hash = functools.cache(lambda s: fnv1a32(s.upper().encode()))
+    alt_prefix_of = functools.cache(lambda s: pack_prefix16(s.encode()))
+    alt_flags_of = functools.cache(_alt_flags)
+    repeat_k_of = functools.cache(_ref_repeat_k)
 
     for i, (code, pos, rec_ord, alt_ord, rec) in enumerate(rows):
         alt = rec.alts[alt_ord]
@@ -324,9 +317,9 @@ def build_index(
         cols["alt_len"][i] = len(alt)
         cols["ref_hash"][i] = allele_hash(ref)
         cols["alt_hash"][i] = allele_hash(alt)
-        cols["ref_repeat_k"][i] = _ref_repeat_k(ref, alt)
+        cols["ref_repeat_k"][i] = repeat_k_of(ref, alt)
         cols["flags"][i] = (
-            _alt_flags(alt)
+            alt_flags_of(alt)
             | (FLAG.AC_INFO if rec.ac is not None else 0)
             | (FLAG.AN_INFO if rec.an is not None else 0)
         )
@@ -583,18 +576,23 @@ def _shard_from(data, meta: dict) -> VariantIndexShard:
     )
 
 
-def save_index(shard: VariantIndexShard, path: str | Path) -> None:
-    """Persist a shard as one compressed npz + json meta sidecar.
+def save_index(
+    shard: VariantIndexShard, path: str | Path, *, compress: bool = True
+) -> None:
+    """Persist a shard as one npz + json meta sidecar.
 
     Writes are atomic (tmp + rename) so a crash mid-save can never leave a
-    truncated shard that bricks the resume path."""
+    truncated shard that bricks the resume path. ``compress=False`` skips
+    the zlib pass — right for short-lived intermediates (per-slice shards
+    are merged and deleted moments later; compressing them was a
+    measurable slice of ingest wall time)."""
     path = Path(path)
     path.parent.mkdir(parents=True, exist_ok=True)
     arrays = _shard_arrays(shard)
     import os
 
     tmp = path.with_name(path.name + ".tmp.npz")
-    np.savez_compressed(tmp, **arrays)
+    (np.savez_compressed if compress else np.savez)(tmp, **arrays)
     os.replace(tmp, path if path.suffix == ".npz" else str(path) + ".npz")
     meta_tmp = Path(str(path) + ".meta.json.tmp")
     meta_tmp.write_text(json.dumps(shard.meta))

@@ -223,13 +223,9 @@ def export_region_files(
 
     # packed_len memoized per unique allele across ALL chromosomes —
     # cohorts repeat the same handful of alleles massively
-    plen_cache: dict[bytes, int] = {}
+    import functools
 
-    def plen(b: bytes) -> int:
-        v = plen_cache.get(b)
-        if v is None:
-            v = plen_cache[b] = packed_len(b)
-        return v
+    plen = functools.cache(packed_len)
 
     for chrom, code in CHROMOSOME_CODES.items():
         lo = int(shard.chrom_offsets[code])

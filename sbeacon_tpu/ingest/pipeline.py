@@ -215,7 +215,18 @@ class SummarisationPipeline:
                 vcf_location=str(vcf),
                 sample_names=sample_names,
             )
-            save_index(shard, spath)
+            # slice shards are merged and deleted moments later, so the
+            # zlib pass is skipped UNLESS the genotype bit planes are
+            # large: planes are mostly zeros (compress 10-50x) and every
+            # slice coexists on disk until the merge, so an uncompressed
+            # many-sample cohort would multiply peak temp-disk usage
+            planes = sum(
+                p.nbytes
+                for p in (shard.gt_bits, shard.gt_bits2,
+                          shard.tok_bits1, shard.tok_bits2)
+                if p is not None
+            )
+            save_index(shard, spath, compress=planes > 16 * 1024 * 1024)
             self.ledger.complete_slice(
                 str(vcf),
                 sl,
