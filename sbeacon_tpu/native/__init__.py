@@ -24,7 +24,13 @@ log = logging.getLogger(__name__)
 
 _SRC = Path(__file__).parent / "src"
 _LIB_PATH = Path(__file__).parent / "_sbnative.so"
-_SOURCES = ["bgzf.cpp", "scan.cpp", "index_codec.cpp", "gt_planes.cpp"]
+_SOURCES = [
+    "bgzf.cpp",
+    "scan.cpp",
+    "index_codec.cpp",
+    "gt_planes.cpp",
+    "tokenize.cpp",
+]
 
 _lock = threading.Lock()
 _lib = None
@@ -104,6 +110,30 @@ def get_lib():
             ctypes.POINTER(ctypes.c_int64),
         ]
         lib.sbn_count_slice.restype = ctypes.c_int
+        u8pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+        u32pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))
+        u64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))
+        i64pp = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))
+        lib.sbn_tokenize.argtypes = [
+            ctypes.POINTER(ctypes.c_uint8),
+            ctypes.c_uint64,
+            ctypes.c_uint64,
+            i64pp,              # pos
+            u32pp, u32pp,       # chrom off/len
+            u32pp, u32pp,       # ref off/len
+            u32pp, u32pp,       # vt off/len
+            i64pp, u8pp, u8pp,  # an, has_an, has_ac
+            i64pp,              # tok_total
+            u32pp, u32pp, u64pp,  # alt off/len/start
+            i64pp,              # ac_gt
+            i64pp, u64pp,       # ac, ac_start
+            u8pp, u64pp,        # gt_blob, gt_off
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.sbn_tokenize.restype = ctypes.c_int
         lib.sbn_line_offsets.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint64,
@@ -386,9 +416,17 @@ def gt_planes(
     planes = [
         np.zeros((n_rows, words), dtype=np.uint32) for _ in range(4)
     ]
-    # zero-copy: the C side only reads the blob; keep the bytes object
-    # referenced (blob_view) for the duration of the call
-    blob_view = np.frombuffer(gt_blob or b"\0", dtype=np.uint8)
+    # zero-copy: the C side only reads the blob; keep the buffer object
+    # referenced (blob_view) for the duration of the call. Accepts bytes
+    # or a uint8 ndarray (the tokenizer's gt_blob output) without copying.
+    if isinstance(gt_blob, np.ndarray):
+        blob_view = (
+            np.ascontiguousarray(gt_blob, dtype=np.uint8)
+            if len(gt_blob)
+            else np.zeros(1, np.uint8)
+        )
+    else:
+        blob_view = np.frombuffer(gt_blob or b"\0", dtype=np.uint8)
     u32 = ctypes.POINTER(ctypes.c_uint32)
     u64 = ctypes.POINTER(ctypes.c_uint64)
     i64p = ctypes.POINTER(ctypes.c_int64)
@@ -450,3 +488,79 @@ def count_slice(text: bytes) -> tuple[int, int, int]:
     if rc != 0:
         raise NativeUnavailable(f"sbn_count_slice failed rc={rc}")
     return nv.value, nc.value, nr.value
+
+
+def tokenize(text: bytes, n_samples: int) -> dict:
+    """One native pass over VCF body text -> flat record/field arrays.
+
+    The columnar fast path's front end (tokenize.cpp): per-record
+    positions and field spans (byte offsets into ``text``), per-alt
+    spans, INFO AC/AN/VT, genotype-derived allele/token tallies, and
+    normalised per-sample GT cells ready for ``gt_planes``. Dict keys
+    mirror the C out-params; span arrays index into the ``text`` the
+    caller passed (keep it alive)."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    if not hasattr(lib, "sbn_tokenize"):
+        raise NativeUnavailable("sbn_tokenize missing (stale library)")
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    u64p = ctypes.POINTER(ctypes.c_uint64)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    outs = {
+        "pos": i64p(),
+        "chrom_off": u32p(), "chrom_len": u32p(),
+        "ref_off": u32p(), "ref_len": u32p(),
+        "vt_off": u32p(), "vt_len": u32p(),
+        "an": i64p(), "has_an": u8p(), "has_ac": u8p(),
+        "tok_total": i64p(),
+        "alt_off": u32p(), "alt_len": u32p(), "alt_start": u64p(),
+        "ac_gt": i64p(),
+        "ac": i64p(), "ac_start": u64p(),
+        "gt_blob": u8p(), "gt_off": u64p(),
+    }
+    n_rec = ctypes.c_uint64()
+    n_alt = ctypes.c_uint64()
+    n_ac = ctypes.c_uint64()
+    gt_blob_len = ctypes.c_uint64()
+    text_view = np.frombuffer(text or b"\0", dtype=np.uint8)
+    rc = lib.sbn_tokenize(
+        text_view.ctypes.data_as(u8p),
+        len(text),
+        n_samples,
+        *[ctypes.byref(v) for v in outs.values()],
+        ctypes.byref(n_rec),
+        ctypes.byref(n_alt),
+        ctypes.byref(n_ac),
+        ctypes.byref(gt_blob_len),
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_tokenize failed rc={rc}")
+    nr, na, nac = n_rec.value, n_alt.value, n_ac.value
+    shapes = {
+        "pos": nr, "chrom_off": nr, "chrom_len": nr,
+        "ref_off": nr, "ref_len": nr, "vt_off": nr, "vt_len": nr,
+        "an": nr, "has_an": nr, "has_ac": nr, "tok_total": nr,
+        "alt_off": na, "alt_len": na, "alt_start": nr + 1,
+        "ac_gt": na, "ac": nac, "ac_start": nr + 1,
+        "gt_blob": gt_blob_len.value,
+        "gt_off": nr * n_samples + 1,
+    }
+    try:
+        result = {
+            k: (
+                np.ctypeslib.as_array(v, shape=(shapes[k],)).copy()
+                if shapes[k]
+                else np.zeros(0, dtype=np.ctypeslib.as_array(v, shape=(1,)).dtype)
+            )
+            for k, v in outs.items()
+        }
+    finally:
+        for v in outs.values():
+            lib.sbn_free(ctypes.cast(v, u8p))
+    result["n_rec"] = nr
+    result["n_alt"] = na
+    return result

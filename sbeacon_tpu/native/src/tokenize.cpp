@@ -1,0 +1,298 @@
+// VCF record tokenizer: one native pass replacing the per-line Python
+// parse (genomics/vcf.parse_record) for the columnar fast path.
+//
+// Native-component parity (SURVEY.md §2.1): this is the record-header
+// walk of the reference's summariseSlice hot loop (reference:
+// lambda/summariseSlice/source/main.cpp:230-237 recordHeader + addCounts,
+// vcf_chunk_reader.h readPastChars/skipPast byte scanning) generalised to
+// emit every field the index build needs as flat arrays: positions, field
+// spans (offsets into the caller's text buffer), per-alt spans, INFO
+// AC/AN/VT, genotype-derived allele/token tallies (the effective_ac/an
+// fallback of genomics/vcf.VcfRecord), and NORMALISED per-sample GT cells
+// for the genotype-plane builder (gt_planes.cpp).
+//
+// Semantics mirror parse_record exactly: lines starting '#' or empty are
+// skipped, lines with <8 tab-separated fields are skipped, only '\n' is
+// treated as a line terminator (a '\r' stays inside the last field), the
+// LAST AC=/AN=/VT= occurrence in INFO wins, and an unparseable AC/AN
+// value yields "absent" (python int() -> ValueError -> None).
+
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+template <typename T>
+T* CopyOut(const std::vector<T>& v) {
+  T* p = static_cast<T*>(std::malloc(v.empty() ? sizeof(T) : v.size() * sizeof(T)));
+  if (p && !v.empty()) std::memcpy(p, v.data(), v.size() * sizeof(T));
+  return p;
+}
+
+// python int(): optional sign then digits, nothing else. Returns false on
+// any deviation (caller treats the field as absent).
+inline bool ParseInt(const char* p, const char* end, int64_t* out) {
+  if (p >= end) return false;
+  bool neg = false;
+  if (*p == '+' || *p == '-') {
+    neg = (*p == '-');
+    ++p;
+    if (p >= end) return false;
+  }
+  int64_t v = 0;
+  for (; p < end; ++p) {
+    if (*p < '0' || *p > '9') return false;
+    if (v > (INT64_MAX - 9) / 10) return false;  // overflow -> "absent"
+    v = v * 10 + (*p - '0');
+  }
+  *out = neg ? -v : v;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+int sbn_tokenize(
+    const uint8_t* text, uint64_t len, uint64_t n_samples,
+    // per-record (n_rec)
+    int64_t** pos_out,
+    uint32_t** chrom_off_out, uint32_t** chrom_len_out,
+    uint32_t** ref_off_out, uint32_t** ref_len_out,
+    uint32_t** vt_off_out, uint32_t** vt_len_out,
+    int64_t** an_out, uint8_t** has_an_out, uint8_t** has_ac_out,
+    int64_t** tok_total_out,
+    // flat per-alt (n_alt) + starts (n_rec+1)
+    uint32_t** alt_off_out, uint32_t** alt_len_out, uint64_t** alt_start_out,
+    int64_t** ac_gt_out,  // genotype tally per alt, aligned with alt_start
+    // INFO AC values (n_ac) + starts (n_rec+1)
+    int64_t** ac_out, uint64_t** ac_start_out,
+    // normalised GT cells: blob + offsets [n_rec*n_samples+1]
+    uint8_t** gt_blob_out, uint64_t** gt_off_out,
+    uint64_t* n_rec_out, uint64_t* n_alt_out, uint64_t* n_ac_out,
+    uint64_t* gt_blob_len_out) {
+  const char* base = reinterpret_cast<const char*>(text);
+  const char* p = base;
+  const char* end = p + len;
+
+  std::vector<int64_t> pos, an, tok_total, ac, ac_gt;
+  std::vector<uint32_t> chrom_off, chrom_len, ref_off, ref_len;
+  std::vector<uint32_t> vt_off, vt_len, alt_off, alt_len;
+  std::vector<uint64_t> alt_start{0}, ac_start{0}, gt_off{0};
+  std::vector<uint8_t> has_an, has_ac, gt_blob;
+  std::vector<std::pair<uint32_t, uint32_t>> fields;  // reused per line
+
+  while (p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', size_t(end - p)));
+    const char* le = nl ? nl : end;
+    if (p < le && *p != '#') {
+      // split the line on tabs
+      fields.clear();
+      const char* f = p;
+      while (true) {
+        const char* t = static_cast<const char*>(
+            std::memchr(f, '\t', size_t(le - f)));
+        const char* fe = t ? t : le;
+        fields.emplace_back(uint32_t(f - base), uint32_t(fe - f));
+        if (!t) break;
+        f = t + 1;
+      }
+      if (fields.size() < 8) {
+        if (!nl) break;
+        p = nl + 1;
+        continue;
+      }
+      int64_t pv;
+      const char* ps = base + fields[1].first;
+      if (!ParseInt(ps, ps + fields[1].second, &pv)) {
+        if (!nl) break;  // malformed POS: skip line (python would raise)
+        p = nl + 1;
+        continue;
+      }
+      pos.push_back(pv);
+      chrom_off.push_back(fields[0].first);
+      chrom_len.push_back(fields[0].second);
+      ref_off.push_back(fields[3].first);
+      ref_len.push_back(fields[3].second);
+
+      // ALT column -> per-alt spans (split on ',')
+      {
+        const char* a = base + fields[4].first;
+        const char* ae = a + fields[4].second;
+        const char* s = a;
+        while (true) {
+          const char* c = static_cast<const char*>(
+              std::memchr(s, ',', size_t(ae - s)));
+          const char* se = c ? c : ae;
+          alt_off.push_back(uint32_t(s - base));
+          alt_len.push_back(uint32_t(se - s));
+          if (!c) break;
+          s = c + 1;
+        }
+      }
+      const uint64_t rec_alt_begin = alt_start.back();
+      alt_start.push_back(alt_len.size());
+      const uint64_t rec_n_alts = alt_len.size() - rec_alt_begin;
+
+      // INFO: AC= / AN= / VT=, LAST occurrence wins
+      uint8_t h_ac = 0, h_an = 0;
+      int64_t an_v = 0;
+      uint32_t vt_o = 0, vt_l = 0;
+      const uint64_t rec_ac_begin = ac.size();
+      {
+        const char* q = base + fields[7].first;
+        const char* qe = q + fields[7].second;
+        while (q < qe) {
+          const char* sc = static_cast<const char*>(
+              std::memchr(q, ';', size_t(qe - q)));
+          const char* fe2 = sc ? sc : qe;
+          if (fe2 - q >= 3 && q[2] == '=') {
+            if (q[0] == 'A' && q[1] == 'C') {
+              ac.resize(rec_ac_begin);  // last AC= wins
+              h_ac = 1;
+              const char* v = q + 3;
+              while (v <= fe2) {
+                const char* cm = static_cast<const char*>(
+                    std::memchr(v, ',', size_t(fe2 - v)));
+                const char* ve = cm ? cm : fe2;
+                int64_t cv;
+                if (!ParseInt(v, ve, &cv)) {
+                  h_ac = 0;  // python: any bad entry -> ac = None
+                  ac.resize(rec_ac_begin);
+                  break;
+                }
+                ac.push_back(cv);
+                if (!cm) break;
+                v = cm + 1;
+              }
+            } else if (q[0] == 'A' && q[1] == 'N') {
+              h_an = ParseInt(q + 3, fe2, &an_v) ? 1 : 0;
+            } else if (q[0] == 'V' && q[1] == 'T') {
+              vt_o = uint32_t(q + 3 - base);
+              vt_l = uint32_t(fe2 - (q + 3));
+            }
+          }
+          if (!sc) break;
+          q = sc + 1;
+        }
+      }
+      has_ac.push_back(h_ac);
+      has_an.push_back(h_an);
+      an.push_back(h_an ? an_v : 0);
+      vt_off.push_back(vt_o);
+      vt_len.push_back(vt_l);
+      ac_start.push_back(ac.size());
+
+      // FORMAT + samples: genotypes only when >9 fields (parse_record)
+      int gt_idx = -1;
+      if (fields.size() > 9) {
+        const char* fm = base + fields[8].first;
+        const char* fme = fm + fields[8].second;
+        int idx = 0;
+        const char* s = fm;
+        while (true) {
+          const char* c = static_cast<const char*>(
+              std::memchr(s, ':', size_t(fme - s)));
+          const char* se = c ? c : fme;
+          if (se - s == 2 && s[0] == 'G' && s[1] == 'T') {
+            gt_idx = idx;
+            break;
+          }
+          if (!c) break;
+          s = c + 1;
+          ++idx;
+        }
+      }
+      ac_gt.resize(ac_gt.size() + rec_n_alts, 0);
+      int64_t* rec_ac_gt = ac_gt.data() + (ac_gt.size() - rec_n_alts);
+      int64_t toks = 0;
+      uint64_t cells_emitted = 0;
+      if (gt_idx >= 0) {
+        for (size_t col = 9; col < fields.size(); ++col) {
+          // the gt_idx-th ':'-separated piece of this sample column
+          const char* s = base + fields[col].first;
+          const char* se = s + fields[col].second;
+          const char* gs = s;
+          int idx = 0;
+          const char* ge = nullptr;
+          while (idx <= gt_idx) {
+            const char* c = static_cast<const char*>(
+                std::memchr(gs, ':', size_t(se - gs)));
+            if (idx == gt_idx) {
+              ge = c ? c : se;
+              break;
+            }
+            if (!c) break;  // fewer pieces than gt_idx: python yields '.'
+            gs = c + 1;
+            ++idx;
+          }
+          // token scan over the GT piece (absent piece = '.', tokenless)
+          if (ge != nullptr) {
+            for (const char* c = gs; c < ge;) {
+              if (*c >= '0' && *c <= '9') {
+                int64_t v = 0;
+                while (c < ge && *c >= '0' && *c <= '9') {
+                  if (v < (int64_t(1) << 40))
+                    v = v * 10 + (*c - '0');
+                  ++c;
+                }
+                ++toks;
+                if (v >= 1 && uint64_t(v) <= rec_n_alts)
+                  ++rec_ac_gt[v - 1];
+              } else {
+                ++c;
+              }
+            }
+          }
+          // normalised cell (first n_samples columns only)
+          if (cells_emitted < n_samples) {
+            if (ge != nullptr) {
+              gt_blob.insert(gt_blob.end(),
+                             reinterpret_cast<const uint8_t*>(gs),
+                             reinterpret_cast<const uint8_t*>(ge));
+            }
+            gt_off.push_back(gt_blob.size());
+            ++cells_emitted;
+          }
+        }
+      }
+      while (cells_emitted < n_samples) {  // pad missing cells empty
+        gt_off.push_back(gt_blob.size());
+        ++cells_emitted;
+      }
+      tok_total.push_back(toks);
+    }
+    if (!nl) break;
+    p = nl + 1;
+  }
+
+  *pos_out = CopyOut(pos);
+  *chrom_off_out = CopyOut(chrom_off);
+  *chrom_len_out = CopyOut(chrom_len);
+  *ref_off_out = CopyOut(ref_off);
+  *ref_len_out = CopyOut(ref_len);
+  *vt_off_out = CopyOut(vt_off);
+  *vt_len_out = CopyOut(vt_len);
+  *an_out = CopyOut(an);
+  *has_an_out = CopyOut(has_an);
+  *has_ac_out = CopyOut(has_ac);
+  *tok_total_out = CopyOut(tok_total);
+  *alt_off_out = CopyOut(alt_off);
+  *alt_len_out = CopyOut(alt_len);
+  *alt_start_out = CopyOut(alt_start);
+  *ac_gt_out = CopyOut(ac_gt);
+  *ac_out = CopyOut(ac);
+  *ac_start_out = CopyOut(ac_start);
+  *gt_blob_out = CopyOut(gt_blob);
+  *gt_off_out = CopyOut(gt_off);
+  *n_rec_out = pos.size();
+  *n_alt_out = alt_len.size();
+  *n_ac_out = ac.size();
+  *gt_blob_len_out = gt_blob.size();
+  return 0;
+}
+
+}  // extern "C"

@@ -156,18 +156,16 @@ def _make_handler(
             npz blob. The VCF location must be reachable from the worker
             (shared filesystem or object-store URL)."""
             try:
-                from ..index.columnar import build_index, dumps_index
-                from ..ingest.pipeline import read_slice_records
+                from ..index.columnar import dumps_index
+                from ..ingest.pipeline import scan_slice_to_shard
 
                 n = int(self.headers.get("Content-Length", 0))
                 p = SliceScanPayload(**json.loads(self.rfile.read(n)))
-                records = read_slice_records(
-                    p.vcf_location, p.vstart, p.vend
-                )
-                shard = build_index(
-                    records,
+                shard = scan_slice_to_shard(
+                    p.vcf_location,
+                    p.vstart,
+                    p.vend,
                     dataset_id=p.dataset_id,
-                    vcf_location=p.vcf_location,
                     sample_names=p.sample_names,
                 )
                 self._send_bytes(200, dumps_index(shard))

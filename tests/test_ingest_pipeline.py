@@ -186,18 +186,18 @@ def test_resume_after_crash(corpus, monkeypatch):
 
     import sbeacon_tpu.ingest.pipeline as pl
 
-    real = pl.read_slice_records
+    real = pl.scan_slice_to_shard
     plan = plan_slices(ensure_index(vcf), pipe.config.ingest)
     poison = plan.slices[len(plan.slices) // 2]
     calls = {"n": 0}
 
-    def flaky(path, a, b):
+    def flaky(path, a, b, **kw):
         if (a, b) == poison and calls["n"] == 0:
             calls["n"] += 1
             raise RuntimeError("simulated crash")
-        return real(path, a, b)
+        return real(path, a, b, **kw)
 
-    monkeypatch.setattr(pl, "read_slice_records", flaky)
+    monkeypatch.setattr(pl, "scan_slice_to_shard", flaky)
     with pytest.raises(RuntimeError):
         pipe.summarise_vcf("ds", str(vcf))
 

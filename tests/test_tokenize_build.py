@@ -1,0 +1,178 @@
+"""Native-tokenized index build parity: build_index_from_text must
+produce BIT-IDENTICAL shards to the python parse_record + build_index
+path across randomized corpora and hand-written edge-case VCF text.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu import native
+from sbeacon_tpu.genomics.vcf import parse_record
+from sbeacon_tpu.index.columnar import build_index, build_index_from_text
+from sbeacon_tpu.testing import random_records
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library unavailable"
+)
+
+
+def _text_of(records, sample_names):
+    lines = ["##fileformat=VCFv4.2"]
+    header = "#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO"
+    if sample_names:
+        header += "\tFORMAT\t" + "\t".join(sample_names)
+    lines.append(header)
+    for r in records:
+        info_parts = []
+        if r.ac is not None:
+            info_parts.append("AC=" + ",".join(map(str, r.ac)))
+        if r.an is not None:
+            info_parts.append(f"AN={r.an}")
+        if r.vt != "N/A":
+            info_parts.append(f"VT={r.vt}")
+        info = ";".join(info_parts) or "."
+        line = (
+            f"{r.chrom}\t{r.pos}\t.\t{r.ref}\t{','.join(r.alts)}\t.\t.\t{info}"
+        )
+        if sample_names:
+            gts = list(r.genotypes[: len(sample_names)])
+            gts += ["./."] * (len(sample_names) - len(gts))
+            line += "\tGT\t" + "\t".join(gts)
+        lines.append(line)
+    return ("\n".join(lines) + "\n").encode()
+
+
+def _assert_shards_equal(a, b):
+    assert a.meta == b.meta
+    assert set(a.cols) == set(b.cols)
+    for k in a.cols:
+        np.testing.assert_array_equal(a.cols[k], b.cols[k], err_msg=k)
+    np.testing.assert_array_equal(a.chrom_offsets, b.chrom_offsets)
+    np.testing.assert_array_equal(a.ref_blob, b.ref_blob)
+    np.testing.assert_array_equal(a.ref_off, b.ref_off)
+    np.testing.assert_array_equal(a.alt_blob, b.alt_blob)
+    np.testing.assert_array_equal(a.alt_off, b.alt_off)
+    np.testing.assert_array_equal(a.vt_codes, b.vt_codes)
+    for plane in (
+        "gt_bits", "gt_bits2", "tok_bits1", "tok_bits2",
+        "gt_overflow", "tok_overflow",
+    ):
+        pa, pb = getattr(a, plane), getattr(b, plane)
+        assert (pa is None) == (pb is None), plane
+        if pa is not None:
+            np.testing.assert_array_equal(pa, pb, err_msg=plane)
+
+
+def _both(text, sample_names):
+    recs = []
+    for line in text.decode().split("\n"):
+        rec = parse_record(line)
+        if rec is not None:
+            recs.append(rec)
+    slow = build_index(
+        recs, dataset_id="d", vcf_location="v", sample_names=sample_names
+    )
+    fast = build_index_from_text(
+        text, dataset_id="d", vcf_location="v", sample_names=sample_names
+    )
+    return slow, fast
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+def test_randomized_parity(seed):
+    rng = random.Random(seed)
+    sample_names = [f"S{i}" for i in range(rng.choice([0, 1, 3, 40]))]
+    recs = []
+    for chrom in ("1", "22", "X", "weird_contig"):
+        recs += random_records(
+            rng,
+            chrom=chrom,
+            n=rng.randint(30, 150),
+            n_samples=len(sample_names),
+            p_symbolic=0.2,
+            p_multiallelic=0.3,
+            p_no_acan=0.4,
+        )
+    rng.shuffle(recs)
+    text = _text_of(recs, sample_names)
+    slow, fast = _both(text, sample_names)
+    _assert_shards_equal(slow, fast)
+    assert fast.n_rows > 0
+
+
+def test_edge_case_lines_parity():
+    text = b"\n".join(
+        [
+            b"##meta",
+            b"#CHROM\tPOS\tID\tREF\tALT\tQUAL\tFILTER\tINFO\tFORMAT\tA\tB",
+            # plain SNV
+            b"1\t100\t.\tA\tG\t.\t.\tAC=1;AN=4\tGT\t0|1\t0|0",
+            # short line (7 fields): skipped by both paths
+            b"1\t101\t.\tA\tG\t.\t.",
+            # FORMAT without GT: no genotypes
+            b"1\t102\t.\tC\tT\t.\t.\tAC=2;AN=4\tDP\t12\t13",
+            # GT not first in FORMAT
+            b"1\t103\t.\tG\tA,T\t.\t.\tAN=4\tDP:GT\t9:1|2\t7:0/1",
+            # sample with fewer pieces than gt_idx -> '.'
+            b"1\t104\t.\tT\tC\t.\t.\t.\tDP:GT\t5\t3:1|1",
+            # bad AC entry -> ac absent (genotype tally)
+            b"1\t105\t.\tA\tG\t.\t.\tAC=x;AN=4\tGT\t1/1\t0|1",
+            # bad AN -> absent (token count)
+            b"1\t106\t.\tA\tG\t.\t.\tAC=1;AN=zz\tGT\t1|0\t.|.",
+            # multiple AC=: last wins
+            b"1\t107\t.\tA\tG,C\t.\t.\tAC=9,9;AN=8;AC=1,2\tGT\t1|2\t2|2",
+            # symbolic + VT + empty-ish fields
+            b"1\t108\t.\tA\t<DEL>,<DUP:TANDEM>\t.\t.\tAC=1,1;AN=4;VT=SV\tGT\t0|1\t0|2",
+            # unknown contig: dropped
+            b"GL000225.1\t50\t.\tA\tG\t.\t.\tAC=1;AN=2\tGT\t1\t0",
+            # haploid + missing + multi-digit allele ids
+            b"2\t200\t.\tA\tG\t.\t.\t.\tGT\t1\t.",
+            # record with extra sample column (beyond header samples)
+            b"2\t201\t.\tC\tA\t.\t.\tAN=5\tGT\t0|1\t1|1\t0|0",
+            # trailing record without newline handled below
+            b"2\t202\t.\tG\tT\t.\t.\tAC=2;AN=4\tGT\t1|1\t0.5",
+        ]
+    )
+    slow, fast = _both(text, ["A", "B"])
+    _assert_shards_equal(slow, fast)
+    # no trailing newline
+    slow2, fast2 = _both(text.rstrip(b"\n"), ["A", "B"])
+    _assert_shards_equal(slow2, fast2)
+
+
+def test_no_samples_and_empty_text_parity():
+    slow, fast = _both(
+        b"#h\n1\t10\t.\tA\tG\t.\t.\tAC=1;AN=2\n", []
+    )
+    _assert_shards_equal(slow, fast)
+    slow, fast = _both(b"##only\n#headers\n", ["A"])
+    _assert_shards_equal(slow, fast)
+
+
+def test_ac_arity_mismatch_refused():
+    text = b"#h\n1\t10\t.\tA\tG,C\t.\t.\tAC=1;AN=2\tGT\t0|1\n"
+    with pytest.raises(ValueError, match="arity"):
+        build_index_from_text(text, sample_names=["A"])
+
+
+def test_crlf_line_endings_parity():
+    # '\r' stays inside the last field on both paths
+    text = b"#h\r\n1\t10\t.\tA\tG\t.\t.\tAC=1;AN=2\tGT\t0|1\r\n"
+    slow, fast = _both(text, ["A"])
+    _assert_shards_equal(slow, fast)
+
+
+def test_overflowing_int_fields_treated_absent():
+    """19+-digit AC/AN values: the fast path treats them as absent
+    (genotype-derived fallback) instead of the python path's
+    OverflowError on int32 assignment — a documented, strictly more
+    robust divergence (no silent wraparound on either path)."""
+    text = (
+        b"#h\n1\t10\t.\tA\tG\t.\t.\t"
+        b"AC=1;AN=99999999999999999999\tGT\t0|1\n"
+    )
+    fast = build_index_from_text(text, sample_names=["A"])
+    assert int(fast.cols["an"][0]) == 2  # token count of '0|1'
+    assert not (fast.cols["flags"][0] & 1024)  # AN_INFO not set
