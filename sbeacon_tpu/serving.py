@@ -11,7 +11,8 @@ replacement for that entire fan-out layer at serving time.
 Leader-election design (no dedicated flusher thread, zero idle cost):
 the first request into an empty accumulator becomes the leader, waits up
 to ``max_wait_ms`` for followers (or until ``max_batch`` arrive), then
-executes the whole batch with one ``run_queries`` call and hands each
+executes the whole batch with one ``run_queries_auto`` call (XLA or
+grouped-Pallas kernel by index type) and hands each
 waiter its row of the results. Batch shapes are padded to power-of-two
 buckets so XLA compiles one program per bucket instead of one per batch
 size.
@@ -69,7 +70,7 @@ class _Accumulator:
 
 
 class MicroBatcher:
-    """Batches ``run_queries`` calls per device index.
+    """Batches kernel launches per device index.
 
     ``submit`` blocks until the caller's query has executed (alone after
     ``max_wait_ms`` of quiet, or sooner as part of a fuller batch) and
