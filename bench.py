@@ -49,6 +49,20 @@ def _time_batch(fn, repeats=REPEATS):
     return min(times)
 
 
+def _pipelined_qps(fn, n_queries, *, reps=16, threads=8):
+    """Sustained queries/s with overlapped in-flight batches (each sync
+    through the tunnel costs a full RTT, so serial timing understates a
+    concurrent server's throughput)."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    with ThreadPoolExecutor(threads) as pool:
+        t0 = time.perf_counter()
+        futs = [pool.submit(fn) for _ in range(reps)]
+        for f in futs:
+            f.result()
+        return reps * n_queries / (time.perf_counter() - t0)
+
+
 def build_corpus():
     from sbeacon_tpu.index.columnar import build_index
     from sbeacon_tpu.testing import random_records
@@ -65,7 +79,7 @@ def build_corpus():
     return records, shard
 
 
-def _timed_best(shard, dindex, enc, ref_results, *, window):
+def _timed_best(shard, dindex, enc, ref_results, *, window, measure_pipelined=True):
     """(best_s, kernel_name, extra): time the grouped Pallas kernel when
     available and exact vs the XLA reference (non-overflow rows equal,
     no fallback needed on bench workloads); otherwise the XLA gather
@@ -110,6 +124,25 @@ def _timed_best(shard, dindex, enc, ref_results, *, window):
                     )
                 )
                 extra = {"_pindex": pindex}  # reuse: device matrix upload
+                if measure_pipelined:
+                    # optional metric: must not discard the validated
+                    # pallas result on a transient tunnel error
+                    try:
+                        extra["pipelined_qps"] = round(
+                            _pipelined_qps(
+                                lambda: run_queries_grouped(
+                                    pindex,
+                                    enc,
+                                    window_cap=window,
+                                    record_cap=64,
+                                    with_rows=False,
+                                ),
+                                len(got.exists),
+                            ),
+                            1,
+                        )
+                    except Exception:
+                        traceback.print_exc(file=sys.stderr)
                 try:
                     dev_s, scanned = device_time_probe(
                         pindex, enc, window_cap=window, iters=32
@@ -178,7 +211,9 @@ def config2_point_queries(shard):
     best_xla = _time_batch(
         lambda: run_queries(dindex, enc, window_cap=512, record_cap=64)
     )
-    best, kernel, extra = _timed_best(shard, dindex, enc, res, window=512)
+    best, kernel, extra = _timed_best(
+        shard, dindex, enc, res, window=512, measure_pipelined=False
+    )  # config2 runs its own (larger) pipelined measurement below
     pindex = extra.pop("_pindex", None)
     detail = {
         "hits": int(res.exists.sum()),
@@ -190,8 +225,6 @@ def config2_point_queries(shard):
     }
     headline = N_QUERIES / best
     if kernel == "pallas" and pindex is not None:
-        from concurrent.futures import ThreadPoolExecutor
-
         from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
 
         # sustained throughput: overlapped in-flight batches amortise the
@@ -206,28 +239,17 @@ def config2_point_queries(shard):
                 with_rows=with_rows,
             )
 
-        with ThreadPoolExecutor(8) as pool:
-            reps = 24
-            t0 = time.perf_counter()
-            futs = [pool.submit(one, False) for _ in range(reps)]
-            for f in futs:
-                f.result()
-            dt = time.perf_counter() - t0
-        headline = reps * N_QUERIES / dt
-        detail["pipelined_qps"] = round(headline, 1)
+        piped = _pipelined_qps(lambda: one(False), N_QUERIES, reps=24)
+        headline = max(headline, piped)
+        detail["pipelined_qps"] = round(piped, 1)
         # record granularity: in-kernel row materialisation (packed match
         # masks) instead of the XLA gather kernel (VERDICT r1 weak #2)
         one(True)
         best_rec = _time_batch(lambda: one(True), repeats=4)
-        with ThreadPoolExecutor(8) as pool:
-            reps = 16
-            t0 = time.perf_counter()
-            futs = [pool.submit(one, True) for _ in range(reps)]
-            for f in futs:
-                f.result()
-            dt = time.perf_counter() - t0
         detail["record_serial_qps"] = round(N_QUERIES / best_rec, 1)
-        detail["record_pipelined_qps"] = round(reps * N_QUERIES / dt, 1)
+        detail["record_pipelined_qps"] = round(
+            _pipelined_qps(lambda: one(True), N_QUERIES), 1
+        )
     return headline, detail
 
 
