@@ -359,3 +359,89 @@ def test_selected_samples_n_ref_stays_on_host(setup):
     )
     assert got.variants == want.variants
     assert got.call_count == want.call_count
+
+
+def test_wide_cohort_pipeline_selected_samples(tmp_path):
+    """Many-sample cohort (512 samples -> multi-word genotype planes)
+    through the REAL pipeline (tokenizer + slices + merge), then
+    selected-samples queries vs the oracle — pins down plane word
+    indexing beyond the first uint32 word."""
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        IngestConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.genomics.tabix import ensure_index
+    from sbeacon_tpu.genomics.vcf import write_vcf
+    from sbeacon_tpu.ingest.pipeline import SummarisationPipeline
+
+    rng = random.Random(77)
+    ns = 512
+    names = [f"W{i}" for i in range(ns)]
+    recs = random_records(
+        rng, chrom="12", n=300, n_samples=ns, p_no_acan=0.5,
+        p_multiallelic=0.3,
+    )
+    vcf = tmp_path / "wide.vcf.gz"
+    write_vcf(vcf, recs, sample_names=names)
+    ensure_index(vcf)
+    config = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "d"),
+        ingest=IngestConfig(workers=1),
+    )
+    config.storage.ensure()
+    shard = SummarisationPipeline(config).summarise_vcf("w", str(vcf))
+    assert shard.gt_bits.shape[1] == ns // 32
+
+    engine = VariantEngine(
+        BeaconConfig(
+            engine=EngineConfig(
+                microbatch=False, use_mesh=False, use_tpu=False
+            )
+        )
+    )
+    engine.add_index(shard)
+    qr = random.Random(5)
+    for _ in range(5):
+        rec = qr.choice(
+            [r for r in recs if not r.alts[0].startswith("<")]
+        )
+        sel = qr.sample(names, 40)
+        sel_idx = [names.index(s) for s in sel]
+        payload = VariantQueryPayload(
+            dataset_ids=["w"],
+            reference_name="12",
+            start_min=rec.pos,
+            start_max=rec.pos,
+            end_min=1,
+            end_max=1 << 30,
+            reference_bases=rec.ref.upper(),
+            alternate_bases=rec.alts[0].upper(),
+            requested_granularity="record",
+            include_datasets="HIT",
+            selected_samples_only=True,
+            sample_names={"w": sel},
+            include_samples=True,
+        )
+        got = engine.search(payload)[0]
+        want = oracle_search(
+            recs,
+            first_bp=rec.pos,
+            last_bp=rec.pos,
+            end_min=1,
+            end_max=1 << 30,
+            reference_bases=rec.ref.upper(),
+            alternate_bases=rec.alts[0].upper(),
+            requested_granularity="record",
+            include_details=True,
+            include_samples=True,
+            sample_names=sel,
+            dataset_id="w",
+            chrom_label="12",
+            selected_sample_idx=sel_idx,
+        )
+        assert got.exists == want.exists
+        assert got.call_count == want.call_count
+        assert got.all_alleles_count == want.all_alleles_count
+        assert got.sample_names == want.sample_names
