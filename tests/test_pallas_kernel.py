@@ -243,3 +243,82 @@ def test_grouped_long_insertion_not_dropped():
     ]
     got2 = run_queries_grouped(pindex_, q2, window_cap=128, record_cap=8)
     assert bool(got2.overflow[0])
+
+
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_grouped_fuzz_across_corpora(seed):
+    """Randomized corpus + mixed query types through the grouped kernel
+    (interpret) vs the XLA kernel: aggregates AND rows equal on every
+    non-overflow query, overflow a superset. Varies corpus shape, W, and
+    caps so group planning, dummy padding, and the host-bounds path all
+    get exercised beyond the shared fixture."""
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    rng = random.Random(seed)
+    recs = []
+    for chrom in rng.sample(["1", "2", "9", "21", "22", "X"], 3):
+        recs += random_records(
+            rng,
+            chrom=chrom,
+            n=rng.randint(100, 500),
+            n_samples=2,
+            p_symbolic=rng.choice([0.0, 0.2]),
+            p_multiallelic=rng.choice([0.1, 0.4]),
+            spacing=rng.choice([10, 200]),
+        )
+    shard = build_index(recs, dataset_id="f", with_genotypes=False)
+    w = rng.choice([128, 256, 512])
+    cap = rng.choice([w // 2, w])
+    rcap = rng.choice([4, 32, 128])
+    pindex = PallasDeviceIndex(shard, window=w)
+    dindex = DeviceIndex(shard, pad_unit=1024)
+    pos = shard.cols["pos"]
+    qs = []
+    for _ in range(80):
+        p = int(pos[rng.randrange(len(pos))]) + rng.randint(-300, 300)
+        p = max(1, p)
+        chrom = rng.choice(["1", "2", "9", "21", "22", "X", "7"])
+        kind = rng.randrange(6)
+        if kind == 0:
+            qs.append(QuerySpec(chrom, p, p, 1, 1 << 30, alternate_bases="N"))
+        elif kind == 1:
+            qs.append(
+                QuerySpec(
+                    chrom, p, p + rng.randint(0, 2000), 1, 1 << 30,
+                    reference_bases=rng.choice("ACGT"),
+                    alternate_bases=rng.choice("ACGT"),
+                )
+            )
+        elif kind == 2:
+            qs.append(
+                QuerySpec(
+                    chrom, max(1, p - 500), p + 500, p, p + 5000,
+                    variant_type=rng.choice(
+                        ["DEL", "INS", "DUP", "DUP:TANDEM", "CNV"]
+                    ),
+                )
+            )
+        elif kind == 3:
+            qs.append(
+                QuerySpec(
+                    chrom, max(1, p - 100), p + 100, 1, 1 << 30,
+                    variant_min_length=rng.randint(0, 3),
+                    variant_max_length=rng.choice([-1, 2, 70000]),
+                    alternate_bases="N",
+                )
+            )
+        elif kind == 4:
+            qs.append(QuerySpec(chrom, 1, 1 << 30, 1, 1 << 30,
+                                alternate_bases="N"))
+        else:
+            qs.append(QuerySpec(chrom, p, p, 1, 1 << 30))
+    want = run_queries(dindex, qs, window_cap=cap, record_cap=rcap)
+    got = run_queries_grouped(pindex, qs, window_cap=cap, record_cap=rcap)
+    assert (got.overflow | ~want.overflow).all()
+    ok = ~got.overflow
+    for key in ("exists", "call_count", "n_variants", "all_alleles_count",
+                "n_matched"):
+        np.testing.assert_array_equal(
+            getattr(got, key)[ok], getattr(want, key)[ok], err_msg=key
+        )
+    np.testing.assert_array_equal(got.rows[ok], want.rows[ok])
