@@ -241,6 +241,10 @@ class BeaconApp:
         if not parts or parts == ["info"]:
             return 200, info_response(info)
         head = parts[0]
+        if head == "health" and len(parts) == 1:
+            # liveness probe (compose/k8s healthchecks; workers expose the
+            # same path): cheap, no store/engine access
+            return 200, {"ok": True, "beaconId": info.beacon_id}
         if head == "schemas":
             # served per-entity default model schemas (the reference
             # vendors these as shared_resources/schemas/ JSON documents;

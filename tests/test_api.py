@@ -825,3 +825,9 @@ def test_entity_schemas_served_and_referenced(app):
     vvalidator = jsonschema.Draft202012Validator(vschema)
     for doc in body["response"]["resultSets"][0]["results"]:
         vvalidator.validate(doc)
+
+
+def test_health_endpoint(app):
+    status, body = app.handle("GET", "/health")
+    assert status == 200 and body["ok"] is True
+    assert body["beaconId"] == app.config.info.beacon_id
