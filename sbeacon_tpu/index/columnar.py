@@ -1010,22 +1010,28 @@ def build_index_from_text(
     )
 
     # -- VT vocab (first appearance over sorted rows; off>0 = present) -----
+    # vectorised: rows map to an effective uid (0 = absent -> "N/A",
+    # else content uid + 1); codes assign per UNIQUE uid in row
+    # first-appearance order, deduplicating by STRING so a literal
+    # "VT=N/A" shares index 0 exactly like the python path's dict
     vt_present = tk["vt_off"] > 0
     vt_uniq, vt_uid_rec = _span_contents(text_np, tk["vt_off"], tk["vt_len"])
-    vt_str_rec = [
-        (vt_uniq[int(u)].decode() if p else "N/A")
-        for u, p in zip(vt_uid_rec, vt_present)
-    ]
+    eff_rec = np.where(vt_present, vt_uid_rec + 1, 0)
+    row_eff = eff_rec[rec_row]
+    _ids, eff_first_order = _first_appearance_ids(
+        np.concatenate([np.zeros(1, np.int64), row_eff])  # "N/A" is code 0
+    )
     vt_vocab = ["N/A"]
     vt_index = {"N/A": 0}
-    vt_codes = np.zeros(n, dtype=np.int16)
-    for i, r in enumerate(rec_row):
-        s = vt_str_rec[int(r)]
+    eff_to_code = np.zeros(len(vt_uniq) + 1, dtype=np.int16)
+    for v in eff_first_order:
+        s = "N/A" if v == 0 else vt_uniq[int(v) - 1].decode()
         c = vt_index.get(s)
         if c is None:
             c = vt_index[s] = len(vt_vocab)
             vt_vocab.append(s)
-        vt_codes[i] = c
+        eff_to_code[int(v)] = c
+    vt_codes = eff_to_code[row_eff]
 
     # -- columns -----------------------------------------------------------
     ref_len_row = tk["ref_len"][rec_row].astype(np.int64)

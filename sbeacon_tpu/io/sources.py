@@ -40,7 +40,16 @@ from urllib.parse import urlparse
 
 
 class RemoteIOError(IOError):
-    """A remote object is unreachable/missing (400/404 at the API edge)."""
+    """A remote object is unreachable/missing (400/404 at the API edge).
+
+    ``status`` carries the HTTP code when one was received (None for
+    transport failures) so callers can tell "definitively absent" (404)
+    from "store said no / store unreachable" — conflating them turns an
+    auth or endpoint problem into a misleading missing-file report."""
+
+    def __init__(self, message: str, status: int | None = None):
+        super().__init__(message)
+        self.status = status
 
 
 _SCHEMES = ("http://", "https://", "s3://")
@@ -178,7 +187,7 @@ class HttpRangeSource(ByteSource):
             except urllib.error.HTTPError as e:
                 if e.code in (404, 403, 401, 416):
                     raise RemoteIOError(
-                        f"{self.location}: HTTP {e.code}"
+                        f"{self.location}: HTTP {e.code}", status=e.code
                     ) from e
                 last = e
             except Exception as e:  # connection resets, timeouts
@@ -190,11 +199,16 @@ class HttpRangeSource(ByteSource):
     # -- ByteSource ---------------------------------------------------------
 
     def exists(self) -> bool:
+        """True/False only for a definitive verdict; auth rejections and
+        transport failures RAISE so callers never mistake a broken token
+        or endpoint for a missing object."""
         try:
             self.size()
             return True
-        except RemoteIOError:
-            return False
+        except RemoteIOError as e:
+            if e.status == 404:
+                return False
+            raise
 
     def size(self) -> int:
         if self._size is not None:

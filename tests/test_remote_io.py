@@ -246,3 +246,18 @@ def test_remote_region_files_manifest(served, tmp_path):
         assert distinct_variant_count_files(
             [remote_root]
         ) == distinct_variant_count_files([out])
+
+
+def test_exists_distinguishes_missing_from_denied(tmp_path):
+    """exists() answers only for a definitive 404; an auth rejection
+    RAISES so a broken token/endpoint is never reported as a missing
+    object (and never negative-cached by the tabix index cache)."""
+    (tmp_path / "obj").write_bytes(b"x" * 10)
+    with range_server(tmp_path, require_token="Bearer s") as base:
+        denied = open_source(f"{base}/obj")
+        with pytest.raises(RemoteIOError) as ei:
+            denied.exists()
+        assert ei.value.status == 403
+    with range_server(tmp_path) as base:
+        assert open_source(f"{base}/obj").exists()
+        assert not open_source(f"{base}/nope").exists()
