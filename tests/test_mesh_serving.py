@@ -237,3 +237,60 @@ def test_beacon_app_serves_through_mesh(tmp_path):
     assert status == 200, body
     assert body["responseSummary"]["exists"] is True
     assert app.engine.mesh_searches == before + 1
+
+
+def test_concurrent_queries_during_reingestion():
+    """Queries racing add_index re-ingestion: no exceptions, and every
+    response is internally consistent (the mesh stack snapshot must never
+    pair stale arrays with replaced shards — engine._mesh_ready)."""
+    import threading
+
+    em, _ = _engines(n_ds=4, n=250)
+    pay = _payload()
+    stop = threading.Event()
+    errors: list[BaseException] = []
+
+    def churn():
+        k = 0
+        while not stop.is_set():
+            rng = random.Random(500 + k)
+            recs = random_records(
+                rng, chrom="7", n=150 + (k % 3) * 40, n_samples=len(SAMPLES)
+            )
+            em.add_index(
+                build_index(
+                    recs,
+                    dataset_id=f"d{k % 4}",
+                    vcf_location=f"v{k % 4}.vcf.gz",
+                    sample_names=SAMPLES,
+                )
+            )
+            k += 1
+
+    def query():
+        while not stop.is_set():
+            try:
+                rs = em.search(pay)
+                assert len(rs) == 4
+                for r in rs:
+                    assert r.call_count >= 0
+                    assert r.all_alleles_count >= 0
+            except BaseException as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=churn)] + [
+        threading.Thread(target=query) for _ in range(3)
+    ]
+    for t in threads:
+        t.start()
+    import time
+
+    time.sleep(4.0)
+    stop.set()
+    for t in threads:
+        t.join(timeout=30)
+    assert not errors, errors[:3]
+    # engine still serves correctly after the churn
+    rs = em.search(pay)
+    assert {r.dataset_id for r in rs} == {"d0", "d1", "d2", "d3"}
