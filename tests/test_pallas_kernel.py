@@ -322,3 +322,20 @@ def test_grouped_fuzz_across_corpora(seed):
             getattr(got, key)[ok], getattr(want, key)[ok], err_msg=key
         )
     np.testing.assert_array_equal(got.rows[ok], want.rows[ok])
+
+
+def test_grouped_empty_shard():
+    """A zero-row shard answers every query empty (no overflow, no rows) —
+    the degenerate stack/window geometry must not trip planning."""
+    from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
+
+    shard = build_index([], dataset_id="e")
+    p = PallasDeviceIndex(shard, window=128)
+    got = run_queries_grouped(
+        p,
+        [QuerySpec("1", 1, 1 << 30, 1, 1 << 30, alternate_bases="N")],
+        window_cap=128,
+        record_cap=8,
+    )
+    assert not got.exists[0] and not got.overflow[0]
+    assert (got.rows[0] == -1).all()
