@@ -261,3 +261,60 @@ def test_exists_distinguishes_missing_from_denied(tmp_path):
     with range_server(tmp_path) as base:
         assert open_source(f"{base}/obj").exists()
         assert not open_source(f"{base}/nope").exists()
+
+
+def test_transient_errors_are_retried(tmp_path):
+    """A store that throws one 500 then recovers must succeed within the
+    retry budget (the reference wraps every S3 GET in a retry loop)."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    payload = b"y" * 5000
+    fail_counter = {"n": 1}  # first request 500s
+
+    class Flaky(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_GET(self):
+            if fail_counter["n"] > 0:
+                fail_counter["n"] -= 1
+                self.send_response(500)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                a, _, b = rng[6:].partition("-")
+                start = int(a)
+                end = int(b) + 1 if b else len(payload)
+                body = payload[start:end]
+                self.send_response(206)
+                self.send_header(
+                    "Content-Range",
+                    f"bytes {start}-{end-1}/{len(payload)}",
+                )
+            else:
+                body = payload
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Flaky)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        url = f"http://127.0.0.1:{srv.server_address[1]}/x"
+        src = HttpRangeSource(url, retries=2)
+        assert src.size() == len(payload)  # survived the 500
+        fail_counter["n"] = 1
+        assert src.read_range(100, 200) == payload[100:200]
+        # retries exhausted -> loud RemoteIOError with no status
+        fail_counter["n"] = 10
+        src2 = HttpRangeSource(url, retries=1)
+        with pytest.raises(RemoteIOError) as ei:
+            src2.size()
+        assert ei.value.status is None  # transient, not definitive
+    finally:
+        srv.shutdown()
+        srv.server_close()
