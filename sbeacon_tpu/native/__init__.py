@@ -1,4 +1,5 @@
-"""Native (C++) hot-path library: BGZF codec + VCF slice scanner.
+"""Native (C++) hot-path library: BGZF codec, VCF slice scanner,
+record tokenizer, index record codec, genotype-plane builder.
 
 One coherent C++17 library replacing the reference's scattered native
 components (SURVEY.md §2.1 ledger: VcfChunkReader, Downloader, shared/gzip,
