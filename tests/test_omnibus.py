@@ -1,0 +1,209 @@
+"""Omnibus integration: every round-2 surface in ONE flow.
+
+A tokened coordinator ingests a cohort whose VCF lives in an
+object store (HTTP range server), via a payloadRef submission, with
+slice scans scattered to a tokened worker fleet sharing the storage
+root; the fleet auto-reloads, serves the dataset over the worker
+protocol, and the Beacon surface answers with schema-referencing
+envelopes. Each piece has focused tests elsewhere — this pins the
+COMPOSITION.
+"""
+
+import json
+import random
+import urllib.request
+
+import pytest
+
+from sbeacon_tpu.api import BeaconApp
+from sbeacon_tpu.api.server import start_background
+from sbeacon_tpu.config import (
+    AuthConfig,
+    BeaconConfig,
+    EngineConfig,
+    IngestConfig,
+    StorageConfig,
+)
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.genomics.tabix import ensure_index
+from sbeacon_tpu.genomics.vcf import write_vcf
+from sbeacon_tpu.ingest import IngestService
+from sbeacon_tpu.parallel.dispatch import (
+    DistributedEngine,
+    WorkerServer,
+    urllib_get,
+)
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records, range_server
+
+SAMPLES = ["A", "B", "C"]
+W_TOKEN = "fleet-secret"
+S_TOKEN = "submit-secret"
+
+
+def test_full_fleet_flow(tmp_path):
+    # object store holding the corpus (VCF + index + the submission doc)
+    corpus = tmp_path / "corpus"
+    corpus.mkdir()
+    rng = random.Random(64)
+    recs = random_records(rng, chrom="13", n=600, n_samples=len(SAMPLES))
+    vcf = corpus / "cohort.vcf.gz"
+    write_vcf(vcf, recs, sample_names=SAMPLES)
+    ensure_index(vcf)
+
+    shared = tmp_path / "shared"
+    workers = []
+    try:
+        with range_server(corpus) as store:
+            vcf_url = f"{store}/cohort.vcf.gz"
+            (corpus / "submission.json").write_text(
+                json.dumps(
+                    {
+                        "datasetId": "omni",
+                        "assemblyId": "GRCh38",
+                        "vcfLocations": [vcf_url],
+                        "dataset": {"id": "omni", "name": "Omni"},
+                        "individuals": [
+                            {
+                                "id": f"i{k}",
+                                "sex": {"id": "NCIT:C16576", "label": "f"},
+                            }
+                            for k in range(len(SAMPLES))
+                        ],
+                        "index": True,
+                    }
+                )
+            )
+
+            def fleet_config():
+                return BeaconConfig(
+                    storage=StorageConfig(root=shared),
+                    ingest=IngestConfig(
+                        min_task_time=1e-6,
+                        scan_rate=1e6,
+                        dispatch_cost=1e-7,
+                        workers=4,
+                    ),
+                    auth=AuthConfig(
+                        submit_token=S_TOKEN, worker_token=W_TOKEN
+                    ),
+                )
+
+            # two tokened workers on the shared storage root
+            for _ in range(2):
+                cfg = fleet_config()
+                cfg.storage.ensure()
+                weng = VariantEngine(
+                    BeaconConfig(
+                        engine=EngineConfig(microbatch=False, use_mesh=False)
+                    )
+                )
+                svc = IngestService(cfg, engine=weng)
+                workers.append(
+                    WorkerServer(
+                        weng, token=W_TOKEN, reload_fn=svc.load_all
+                    ).start_background()
+                )
+
+            cfg = fleet_config()
+            cfg = BeaconConfig(
+                storage=cfg.storage,
+                ingest=IngestConfig(
+                    min_task_time=1e-6,
+                    scan_rate=1e6,
+                    dispatch_cost=1e-7,
+                    workers=4,
+                    scan_worker_urls=tuple(w.address for w in workers),
+                ),
+                auth=cfg.auth,
+            )
+            cfg.storage.ensure()
+            app = BeaconApp(cfg)
+            server, _ = start_background(app)
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            try:
+                # payloadRef submit over HTTP with the bearer token
+                req = urllib.request.Request(
+                    f"{base}/submit",
+                    data=json.dumps(
+                        {"payloadRef": f"{store}/submission.json"}
+                    ).encode(),
+                    headers={
+                        "Content-Type": "application/json",
+                        "Authorization": f"Bearer {S_TOKEN}",
+                    },
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    assert r.status == 200
+
+                # slice scans actually scattered to the fleet
+                pool = app.ingest.pipeline.scan_pool
+                assert pool is not None and pool._next >= 1
+
+                # fleet auto-reloaded from shared storage and serves
+                for w in workers:
+                    status, doc = urllib_get(
+                        f"{w.address}/datasets",
+                        10,
+                        {"Authorization": f"Bearer {W_TOKEN}"},
+                    )
+                    assert status == 200 and doc["datasets"] == ["omni"]
+                dist = DistributedEngine(
+                    [w.address for w in workers], token=W_TOKEN
+                )
+                try:
+                    rs = dist.search(
+                        VariantQueryPayload(
+                            dataset_ids=[],
+                            reference_name="13",
+                            start_min=1,
+                            start_max=1 << 30,
+                            end_min=1,
+                            end_max=1 << 30,
+                            alternate_bases="N",
+                            include_datasets="HIT",
+                        )
+                    )
+                    assert {r.dataset_id for r in rs} == {"omni"}
+                finally:
+                    dist.close()
+
+                # Beacon surface answers with schema-referencing envelopes
+                rec = next(
+                    r
+                    for r in recs
+                    if sum(r.effective_ac()) > 0
+                    and not r.alts[0].startswith("<")
+                )
+                q = {
+                    "query": {
+                        "requestedGranularity": "record",
+                        "includeResultsetResponses": "HIT",
+                        "requestParameters": {
+                            "assemblyId": "GRCh38",
+                            "referenceName": "13",
+                            "start": [rec.pos - 1],
+                            "end": [rec.pos + len(rec.ref) - 1],
+                            "referenceBases": rec.ref.upper(),
+                            "alternateBases": rec.alts[0].upper(),
+                        },
+                    }
+                }
+                req = urllib.request.Request(
+                    f"{base}/g_variants",
+                    data=json.dumps(q).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                with urllib.request.urlopen(req, timeout=60) as r:
+                    body = json.loads(r.read())
+                assert body["responseSummary"]["exists"] is True
+                schema_ref = body["meta"]["returnedSchemas"][0]["schema"]
+                assert schema_ref.endswith("/schemas/genomicVariant")
+            finally:
+                server.shutdown()
+                server.server_close()
+    finally:
+        for w in workers:
+            w.shutdown()
