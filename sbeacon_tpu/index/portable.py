@@ -80,6 +80,32 @@ def packed_len(seq: bytes) -> int:
     return 1 if n == 1 else n // 2 + n % 2
 
 
+_BASE_MEMBER = np.zeros(256, dtype=bool)
+for _b in _BASE_CODE:
+    _BASE_MEMBER[_b] = True
+
+
+def packed_len_rows(blob: np.ndarray, off: np.ndarray) -> np.ndarray:
+    """Vectorised :func:`packed_len` over every (blob, offsets) row —
+    symbolic detection via first/last bytes, packability via a segment
+    all() (cumsum-of-nonmembers difference), same arithmetic."""
+    off = off.astype(np.int64)
+    lens = np.diff(off)
+    n = len(lens)
+    starts, ends = off[:-1], off[1:]
+    nz = lens > 0
+    first = np.zeros(n, np.uint8)
+    last = np.zeros(n, np.uint8)
+    first[nz] = blob[starts[nz]]
+    last[nz] = blob[ends[nz] - 1]
+    symbolic = (lens >= 2) & (first == 0x3C) & (last == 0x3E)
+    bad_cum = np.zeros(len(blob) + 1, np.int64)
+    np.cumsum(~_BASE_MEMBER[blob], out=bad_cum[1:] if len(blob) else None)
+    packable = (bad_cum[ends] - bad_cum[starts]) == 0
+    packed = np.where(lens == 1, 1, lens // 2 + lens % 2)
+    return np.where(symbolic, lens - 2, np.where(packable, packed, lens))
+
+
 def unpack_seq(packed: bytes) -> bytes | None:
     """Inverse of :func:`pack_seq` for packed payloads; None when the
     bytes cannot be a packed sequence.
@@ -202,8 +228,6 @@ def export_region_files(
     pos = shard.cols["pos"]
     ref_off = shard.ref_off
     alt_off = shard.alt_off
-    ref_blob = shard.ref_blob.tobytes()
-    alt_blob = shard.alt_blob.tobytes()
     written: list[Path] = []
 
     # re-ingest must not leave stale region files from a previous export
@@ -216,16 +240,12 @@ def export_region_files(
         shutil.rmtree(old, ignore_errors=True)
 
     def row_ref_b(i: int) -> bytes:
-        return ref_blob[ref_off[i] : ref_off[i + 1]]
+        # python-fallback flush only (the native path slices blobs whole)
+        return shard.ref_blob[ref_off[i] : ref_off[i + 1]].tobytes()
 
     def row_alt_b(i: int) -> bytes:
-        return alt_blob[alt_off[i] : alt_off[i + 1]]
+        return shard.alt_blob[alt_off[i] : alt_off[i + 1]].tobytes()
 
-    # packed_len memoized per unique allele across ALL chromosomes —
-    # cohorts repeat the same handful of alleles massively
-    import functools
-
-    plen = functools.cache(packed_len)
 
     for chrom, code in CHROMOSOME_CODES.items():
         lo = int(shard.chrom_offsets[code])
@@ -236,25 +256,47 @@ def export_region_files(
         rdir.mkdir(parents=True, exist_ok=True)
         # raw record size = 10-byte header + packed ref + '_' + packed alt
         # (the reference's {size} suffix counts the pre-gzip packed stream,
-        # write_data_to_s3.h bufferLength)
-        rec_raw = np.asarray(
-            [
-                10 + plen(row_ref_b(i)) + 1 + plen(row_alt_b(i))
-                for i in range(lo, hi)
-            ],
-            dtype=np.int64,
+        # write_data_to_s3.h bufferLength) — vectorised over JUST this
+        # chromosome's blob span (whole-blob work per chromosome would be
+        # O(n_chroms x blob))
+        r0, a0 = int(ref_off[lo]), int(alt_off[lo])
+        rec_raw = (
+            10
+            + packed_len_rows(
+                shard.ref_blob[r0 : int(ref_off[hi])],
+                ref_off[lo : hi + 1].astype(np.int64) - r0,
+            )
+            + 1
+            + packed_len_rows(
+                shard.alt_blob[a0 : int(alt_off[hi])],
+                alt_off[lo : hi + 1].astype(np.int64) - a0,
+            )
         )
         start = lo
         raw_bytes = 0
 
         def flush(start_row: int, end_row: int, raw: int):
             """[start_row, end_row) -> one region file."""
-            blob = pack_records(
-                pos[start_row:end_row].astype(np.uint64),
-                [row_ref_b(i) for i in range(start_row, end_row)],
-                [row_alt_b(i) for i in range(start_row, end_row)],
-                level=level,
-            )
+            if native.available():
+                # zero-copy: shard blob slices + rebased offsets go
+                # straight to the native packer (no per-row bytes)
+                r0, r1 = int(ref_off[start_row]), int(ref_off[end_row])
+                a0, a1 = int(alt_off[start_row]), int(alt_off[end_row])
+                blob = native.pack_records_arrays(
+                    pos[start_row:end_row].astype(np.uint64),
+                    shard.ref_blob[r0:r1],
+                    ref_off[start_row : end_row + 1] - r0,
+                    shard.alt_blob[a0:a1],
+                    alt_off[start_row : end_row + 1] - a0,
+                    level=level,
+                )
+            else:
+                blob = pack_records(
+                    pos[start_row:end_row].astype(np.uint64),
+                    [row_ref_b(i) for i in range(start_row, end_row)],
+                    [row_alt_b(i) for i in range(start_row, end_row)],
+                    level=level,
+                )
             name = f"{int(pos[start_row])}-{int(pos[end_row - 1])}-{raw}"
             path = rdir / name
             path.write_bytes(blob)

@@ -272,12 +272,12 @@ def pack_records(
     pos, refs: list[bytes], alts: list[bytes], *, level: int = 9
 ) -> bytes:
     """Gzip blob of (pos, packed ref'_'alt) records — the reference
-    writeDataToS3 on-S3 index format (write_data_to_s3.h:30-228)."""
+    writeDataToS3 on-S3 index format (write_data_to_s3.h:30-228).
+
+    List form: joins the per-row bytes and delegates to the columnar
+    ``pack_records_arrays`` (one FFI call site)."""
     import numpy as np
 
-    lib = get_lib()
-    if lib is None:
-        raise NativeUnavailable("native library not built")
     n = len(refs)
     pos_a = np.ascontiguousarray(pos, dtype=np.uint64)
     if pos_a.shape != (n,) or len(alts) != n:
@@ -285,42 +285,15 @@ def pack_records(
 
     def runs(items):
         cum = np.cumsum([len(b) for b in items], dtype=np.uint64)
-        if len(cum) and cum[-1] >= 2**32:
-            raise ValueError("total allele bytes exceed u32 offset space")
-        offs = np.zeros(n + 1, dtype=np.uint32)
+        offs = np.zeros(n + 1, dtype=np.uint64)
         offs[1:] = cum
-        return b"".join(items), offs
+        return np.frombuffer(b"".join(items), dtype=np.uint8), offs
 
-    ref_bytes, ref_offs = runs(refs)
-    alt_bytes, alt_offs = runs(alts)
-    out_p = ctypes.POINTER(ctypes.c_uint8)()
-    out_len = ctypes.c_uint64()
-
-    def u8(b):
-        return (
-            (ctypes.c_uint8 * len(b)).from_buffer_copy(b)
-            if b
-            else (ctypes.c_uint8 * 1)()
-        )
-
-    rc = lib.sbn_pack_records(
-        n,
-        pos_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
-        u8(ref_bytes),
-        ref_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        u8(alt_bytes),
-        alt_offs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
-        level,
-        ctypes.byref(out_p),
-        ctypes.byref(out_len),
+    ref_blob, ref_offs = runs(refs)
+    alt_blob, alt_offs = runs(alts)
+    return pack_records_arrays(
+        pos_a, ref_blob, ref_offs, alt_blob, alt_offs, level=level
     )
-    if rc == 3:
-        # data error, not an environment error — match the pure-Python
-        # encoder's exception for the same input
-        raise ValueError("allele too long for u16 record length")
-    if rc != 0:
-        raise NativeUnavailable(f"sbn_pack_records failed rc={rc}")
-    return _take_buffer(lib, out_p, out_len)
 
 
 def unpack_records(
@@ -565,3 +538,62 @@ def tokenize(text: bytes, n_samples: int) -> dict:
     result["n_rec"] = nr
     result["n_alt"] = na
     return result
+
+
+def pack_records_arrays(
+    pos, ref_blob, ref_offs, alt_blob, alt_offs, *, level: int = 6
+) -> bytes:
+    """pack_records over columnar inputs (uint8 blobs + uint32 offsets) —
+    the export path's zero-copy form: shard blobs slice straight in, no
+    per-row python bytes objects."""
+    import numpy as np
+
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    pos_a = np.ascontiguousarray(pos, dtype=np.uint64)
+    ref_b = np.ascontiguousarray(ref_blob, dtype=np.uint8)
+    alt_b = np.ascontiguousarray(alt_blob, dtype=np.uint8)
+    n = len(pos_a)
+    # validate BEFORE the uint32 cast: silent modular wrap of >=2^32
+    # offsets (or offsets outside the blob) would hand the C side an
+    # out-of-bounds read and a silently corrupt blob
+    for name, offs, blob in (
+        ("ref", ref_offs, ref_b),
+        ("alt", alt_offs, alt_b),
+    ):
+        offs = np.asarray(offs)
+        if len(offs) != n + 1:
+            raise ValueError(f"{name} offsets must have n+1 entries")
+        if len(offs) and int(offs[-1]) >= 2**32:
+            raise ValueError("total allele bytes exceed u32 offset space")
+        if len(offs) and (
+            int(offs[0]) != 0
+            or int(offs[-1]) != len(blob)
+            or (np.diff(offs) < 0).any()
+        ):
+            raise ValueError(f"{name} offsets malformed for blob")
+    ref_o = np.ascontiguousarray(ref_offs, dtype=np.uint32)
+    alt_o = np.ascontiguousarray(alt_offs, dtype=np.uint32)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+    out_p = u8p()
+    out_len = ctypes.c_uint64()
+    # keep 1-byte dummies for empty blobs (NULL data pointers otherwise)
+    ref_mem = ref_b if len(ref_b) else np.zeros(1, np.uint8)
+    alt_mem = alt_b if len(alt_b) else np.zeros(1, np.uint8)
+    rc = lib.sbn_pack_records(
+        n,
+        pos_a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+        ref_mem.ctypes.data_as(u8p),
+        ref_o.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        alt_mem.ctypes.data_as(u8p),
+        alt_o.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        level,
+        ctypes.byref(out_p),
+        ctypes.byref(out_len),
+    )
+    if rc == 3:
+        raise ValueError("allele too long for u16 record length")
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_pack_records failed rc={rc}")
+    return _take_buffer(lib, out_p, out_len)

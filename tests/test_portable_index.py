@@ -262,3 +262,36 @@ def test_truncated_trailing_member_raises():
     if native.available():
         with pytest.raises(Exception):
             native.unpack_records(bad)
+
+
+def test_pack_records_arrays_equals_list_form():
+    """The zero-copy columnar packer form must emit byte-identical blobs
+    to the list form (same C function, different marshalling)."""
+    if not native.available():
+        pytest.skip("native unavailable")
+    rng = random.Random(4)
+    refs = [rng.choice([b"A", b"CG", b"<DEL>", b"ACGTACGT"]) for _ in range(50)]
+    alts = [rng.choice([b"T", b"", b"<CN0>", b"NNN", b"ACGT" * 10]) for _ in range(50)]
+    pos = np.arange(100, 100 + 50, dtype=np.uint64)
+    want = native.pack_records(pos, refs, alts, level=6)
+    ref_blob = np.frombuffer(b"".join(refs), dtype=np.uint8)
+    alt_blob = np.frombuffer(b"".join(alts), dtype=np.uint8)
+    ref_off = np.zeros(51, np.uint32); ref_off[1:] = np.cumsum([len(b) for b in refs])
+    alt_off = np.zeros(51, np.uint32); alt_off[1:] = np.cumsum([len(b) for b in alts])
+    got = native.pack_records_arrays(pos, ref_blob, ref_off, alt_blob, alt_off, level=6)
+    assert got == want
+
+
+def test_packed_len_rows_matches_scalar():
+    rng = random.Random(9)
+    seqs = [
+        b"", b"A", b"AC", b"ACG", b"<DEL>", b"<CN0>", b"N", b"XYZ",
+        b"ACGTN" * 7, b"<DUP:TANDEM>", b"A<",
+    ] + [bytes(rng.choice(b"ACGTNX") for _ in range(rng.randint(0, 20)))
+         for _ in range(40)]
+    blob = np.frombuffer(b"".join(seqs), dtype=np.uint8)
+    off = np.zeros(len(seqs) + 1, np.int64)
+    off[1:] = np.cumsum([len(s) for s in seqs])
+    got = pt.packed_len_rows(blob, off)
+    want = [pt.packed_len(s) for s in seqs]
+    assert got.tolist() == want
