@@ -148,8 +148,11 @@ def _timed_best(shard, dindex, enc, ref_results, *, window, measure_pipelined=Tr
                     except Exception:
                         traceback.print_exc(file=sys.stderr)
                 try:
+                    # iters is the differencing-chain delta: at ~0.25
+                    # ms/batch device time, 128 serialized batches give a
+                    # ~30 ms signal vs ~1-3 ms of tunnel RTT jitter
                     dev_s, scanned = device_time_probe(
-                        pindex, enc, window_cap=window, iters=32
+                        pindex, enc, window_cap=window, iters=128
                     )
                     extra.update(
                         device_ms_per_batch=round(dev_s * 1e3, 3),
@@ -365,10 +368,13 @@ def config1_single_snv(records, shard):
                 reference_bases=rec.ref.upper(),
                 alternate_bases=rec.alts[0].upper(),
             )
+            # a single query is one grid step (~1.5 us): the chain must
+            # be very long for the differencing signal to rise above
+            # RTT jitter
             dev_s, _ = device_time_probe(
-                pindex, [spec], window_cap=512, iters=64
+                pindex, [spec], window_cap=512, iters=16384
             )
-            out["device_ms"] = round(dev_s * 1e3, 3)
+            out["device_ms"] = round(dev_s * 1e3, 4)
     except Exception:
         traceback.print_exc(file=sys.stderr)
     if _colocated is not None:

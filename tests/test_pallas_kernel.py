@@ -339,3 +339,63 @@ def test_grouped_empty_shard():
     )
     assert not got.exists[0] and not got.overflow[0]
     assert (got.rows[0] == -1).all()
+
+
+@pytest.mark.parametrize("n_alts", [4, 9])
+def test_grouped_high_arity_first_match(n_alts):
+    """AN counts once per record at every arity tier: multi-shift path
+    (arity 3..7) and the segmented-scan fallback (arity > 7, where
+    ``_dup_shifts`` returns -1). Guards both first-match implementations
+    against divergence — normal corpora only exercise arity <= 2."""
+    from sbeacon_tpu.genomics.vcf import VcfRecord
+    from sbeacon_tpu.ops.pallas_kernel import (
+        _MAX_DUP_SHIFTS,
+        _dup_shifts,
+        run_queries_grouped,
+    )
+
+    alts = ["ACGTGT"[: 1 + (i % 5)] + "T" * (i // 5) for i in range(n_alts)]
+    recs = [
+        VcfRecord(
+            chrom="1", pos=500, ref="G", alts=["C"],
+            ac=[1], an=6, vt="N/A", genotypes=[],
+        ),
+        VcfRecord(
+            chrom="1", pos=1000, ref="A", alts=alts,
+            ac=list(range(1, n_alts + 1)), an=2 * n_alts, vt="N/A",
+            genotypes=[],
+        ),
+        VcfRecord(
+            chrom="1", pos=1000, ref="AT", alts=["A"],
+            ac=[3], an=8, vt="N/A", genotypes=[],
+        ),
+    ]
+    shard = build_index(recs, dataset_id="d")
+    pindex = PallasDeviceIndex(shard, window=128)
+    assert pindex.max_arity == n_alts
+    expect_fallback = (n_alts - 1) > _MAX_DUP_SHIFTS
+    assert (_dup_shifts(pindex) == -1) is expect_fallback
+    dindex = DeviceIndex(shard, pad_unit=1024)
+    qs = [
+        # spans the whole multi-alt record: AN must count once per record
+        QuerySpec("1", 1, 5_000, 1, 1 << 30, alternate_bases="N"),
+        # matches a strict subset of the record's alts (len >= 2 only):
+        # first-match must pick the first MATCHED lane, not lane 0
+        QuerySpec(
+            "1", 900, 1100, 1, 1 << 30,
+            variant_type="INS", variant_min_length=2, variant_max_length=-1,
+        ),
+        # single-alt record before the run: unaffected by neighbours
+        QuerySpec("1", 500, 500, 1, 1 << 30, alternate_bases="C"),
+    ]
+    want = run_queries(dindex, qs, window_cap=128, record_cap=32)
+    got = run_queries_grouped(pindex, qs, window_cap=128, record_cap=32)
+    assert not got.overflow.any()
+    for key in (
+        "exists", "call_count", "n_variants", "all_alleles_count",
+        "n_matched",
+    ):
+        np.testing.assert_array_equal(
+            getattr(got, key), getattr(want, key), err_msg=key
+        )
+    np.testing.assert_array_equal(got.rows, want.rows)
