@@ -368,9 +368,9 @@ def config1_single_snv(records, shard):
                 reference_bases=rec.ref.upper(),
                 alternate_bases=rec.alts[0].upper(),
             )
-            # a single query is one grid step (~1.5 us): the chain must
-            # be very long for the differencing signal to rise above
-            # RTT jitter
+            # a single query is one grid step (~2.7 us measured on v5e,
+            # BASELINE.md config1): the chain must be very long for the
+            # differencing signal to rise above RTT jitter
             dev_s, _ = device_time_probe(
                 pindex, [spec], window_cap=512, iters=16384
             )

@@ -834,7 +834,10 @@ def _probe_rep(
     _, outs = jax.lax.scan(body, starts_d, None, length=k)
     # scalar result: both probe chain lengths must transfer IDENTICAL
     # bytes or the difference no longer cancels the transfer cost; the
-    # sum still depends on every iteration so none can be elided
+    # sum still depends on every iteration so none can be elided.
+    # NOTE: the scalar is timing ballast only — at large k the int32 sum
+    # of call_counts may wrap (int64 is unavailable without x64 mode);
+    # never assert on its value.
     return jnp.sum(outs)
 
 
