@@ -17,12 +17,16 @@ Supported schemes:
 - ``http(s)://`` — HTTP Range requests with retries; servers that ignore
   Range fall back to a cached whole-object GET;
 - ``s3://bucket/key`` — mapped onto the HTTP backend against an
-  S3-compatible endpoint (``BEACON_S3_ENDPOINT``, path-style), with an
-  optional static ``Authorization`` header (``BEACON_S3_TOKEN``). Real
-  AWS SigV4 signing is intentionally out of scope: deployments use
-  presigned URLs, an authenticating gateway, or an S3-compatible store
-  that accepts bearer/anonymous reads (the reference delegates the same
-  concern to IAM roles outside its code).
+  S3-compatible endpoint (``BEACON_S3_ENDPOINT``, path-style; defaults
+  to the real AWS endpoint for the configured region when unset and
+  SigV4 credentials are present), with per-request **AWS SigV4
+  signing** (``io/sigv4.py``) when ``BEACON_S3_ACCESS_KEY`` /
+  ``BEACON_S3_SECRET_KEY`` are configured — private buckets work
+  without a gateway, re-homing the reference's IAM-role data plane
+  (reference: iam.tf:4-868; performQuery/search_variants.py:42-50).
+  A static ``Authorization`` header (``BEACON_S3_TOKEN``) remains for
+  bearer-authenticating S3-compatibles, and presigned/anonymous URLs
+  keep working with neither configured.
 
 Every read retries transient failures (the reference wraps each S3 GET
 in a retry loop, shared/awsutils.cpp:62-65).
@@ -59,23 +63,43 @@ def is_remote(location: str | Path) -> bool:
     return str(location).startswith(_SCHEMES)
 
 
-def resolve_s3(url: str) -> tuple[str, dict]:
-    """s3://bucket/key -> (http url, headers) via the configured
-    S3-compatible endpoint."""
+def resolve_s3(url: str):
+    """s3://bucket/key -> (http url, headers, signer|None) via the
+    configured S3-compatible endpoint. With SigV4 credentials in the
+    environment and no explicit endpoint, the real AWS regional
+    endpoint is assumed (path-style)."""
+    from .sigv4 import signer_from_env
+
+    signer = signer_from_env()
     endpoint = os.environ.get("BEACON_S3_ENDPOINT", "")
     if not endpoint:
-        raise RemoteIOError(
-            f"cannot read {url}: set BEACON_S3_ENDPOINT to an "
-            "S3-compatible HTTP endpoint (path-style)"
-        )
-    parsed = urlparse(url)
+        if signer is None:
+            raise RemoteIOError(
+                f"cannot read {url}: set BEACON_S3_ENDPOINT to an "
+                "S3-compatible HTTP endpoint (path-style), or configure "
+                "BEACON_S3_ACCESS_KEY/BEACON_S3_SECRET_KEY for AWS SigV4"
+            )
+        endpoint = f"https://s3.{signer.region}.amazonaws.com"
+    # split bucket/key WITHOUT urlparse: a '#' or '?' in an object key is
+    # literal key material for S3, not a fragment/query delimiter
+    rest = url[len("s3://"):]
+    bucket, _, key = rest.partition("/")
+    from urllib.parse import quote
+
+    # percent-encode the key exactly once; the signer uses this same
+    # encoded wire path verbatim as the canonical URI, so wire and
+    # canonical forms cannot diverge for reserved characters
+    enc_key = quote(key, safe="/-._~")
     headers = {}
     token = os.environ.get("BEACON_S3_TOKEN", "")
-    if token:
+    if token and signer is None:
+        # a static Authorization header would collide with the SigV4
+        # Authorization; credentials take precedence when both are set
         headers["Authorization"] = token
     return (
-        f"{endpoint.rstrip('/')}/{parsed.netloc}{parsed.path}",
+        f"{endpoint.rstrip('/')}/{bucket}/{enc_key}",
         headers,
+        signer,
     )
 
 
@@ -138,8 +162,9 @@ class HttpRangeSource(ByteSource):
         max_object_bytes: int | None = None,
     ):
         self.location = url
+        self._signer = None
         if url.startswith("s3://"):
-            url, s3_headers = resolve_s3(url)
+            url, s3_headers, self._signer = resolve_s3(url)
             headers = {**s3_headers, **(headers or {})}
         self._url = url
         self._headers = dict(headers or {})
@@ -172,10 +197,14 @@ class HttpRangeSource(ByteSource):
     # -- low-level ----------------------------------------------------------
 
     def _request(self, extra_headers: dict, method: str = "GET"):
+        headers = {**self._headers, **extra_headers}
+        if self._signer is not None:
+            # per-request SigV4: the signature covers every header sent
+            # (incl. this request's Range), so each chunked GET signs
+            # itself — signer is stateless/thread-safe for the pool
+            headers = self._signer.sign(method, self._url, headers)
         req = urllib.request.Request(
-            self._url,
-            headers={**self._headers, **extra_headers},
-            method=method,
+            self._url, headers=headers, method=method
         )
         return urllib.request.urlopen(req, timeout=self._timeout_s)
 

@@ -25,6 +25,20 @@ from sbeacon_tpu.testing import random_records, range_server
 SAMPLES = ["S0", "S1"]
 
 
+@pytest.fixture(autouse=True)
+def _no_ambient_s3_credentials(monkeypatch):
+    """These tests pin the anonymous/bearer s3 paths; ambient SigV4
+    credentials (BEACON_S3_ACCESS_KEY/...) would silently reroute them
+    to the signing path (and the no-endpoint test to real AWS)."""
+    for var in (
+        "BEACON_S3_ACCESS_KEY",
+        "BEACON_S3_SECRET_KEY",
+        "BEACON_S3_SESSION_TOKEN",
+        "BEACON_S3_TOKEN",
+    ):
+        monkeypatch.delenv(var, raising=False)
+
+
 @pytest.fixture(scope="module")
 def served(tmp_path_factory):
     """(base_url, dir, records, vcf_name) — a bgzipped+indexed VCF behind

@@ -1,0 +1,230 @@
+"""SigV4 signing: AWS-published vectors + signed s3:// data-plane wiring.
+
+The vectors pin the algorithm to AWS's own documentation examples; the
+end-to-end test proves the wiring — a private S3-compatible server that
+*rejects* unsigned/garbage requests serves ranged GETs (including the
+concurrent chunked path, where every chunk must carry its own valid
+signature over its own Range header).
+"""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from sbeacon_tpu.io.sigv4 import (
+    SigV4Signer,
+    derive_signing_key,
+    signer_from_env,
+)
+
+SECRET = "wJalrXUtnFEMI/K7MDENG+bPxRfiCYEXAMPLEKEY"
+
+
+def test_signing_key_derivation_vectors():
+    # AWS docs, "Deriving the signing key" — both published examples
+    assert (
+        derive_signing_key(SECRET, "20120215", "us-east-1", "iam").hex()
+        == "f4780e2d9f65fa895f9c67b32ce1baf0b0d8a43505a000a1a9e090d414db404d"
+    )
+    assert (
+        derive_signing_key(SECRET, "20150830", "us-east-1", "iam").hex()
+        == "c4afb1cc5771d871763a393e44b703571b55cc28424d1a5e86da6ed3c154a4b9"
+    )
+
+
+def test_get_vanilla_suite_vector():
+    # AWS SigV4 test suite, get-vanilla: GET / against
+    # example.amazonaws.com at 20150830T123600Z, service "service"
+    signer = SigV4Signer(
+        "AKIDEXAMPLE", SECRET, region="us-east-1", service="service"
+    )
+    now = time.strptime("20150830T123600Z", "%Y%m%dT%H%M%SZ")
+    hdrs = signer.sign(
+        "GET",
+        "https://example.amazonaws.com/",
+        {},
+        payload_hash=(
+            "e3b0c44298fc1c149afbf4c8996fb92427ae41e4649b934ca495991b7852b855"
+        ),
+        now=now,
+    )
+    assert hdrs["Authorization"] == (
+        "AWS4-HMAC-SHA256 "
+        "Credential=AKIDEXAMPLE/20150830/us-east-1/service/aws4_request, "
+        "SignedHeaders=host;x-amz-date, "
+        "Signature=5fa00fa31553b73ebf1942676e86291e8372ff2a2260956d9b8aae1d763fbf31"
+    )
+
+
+def test_query_and_header_canonicalisation():
+    signer = SigV4Signer("AK", "SK", region="eu-west-1")
+    now = time.gmtime(1_700_000_000)
+    # query params re-sort and re-encode identically whether given
+    # pre-encoded or raw; header names case-fold; values space-collapse
+    a = signer.sign(
+        "GET", "https://h/o?b=2&a=1", {"X-Custom": "a  b"}, now=now
+    )
+    b = signer.sign(
+        "GET", "https://h/o?a=1&b=2", {"x-custom": "a b"}, now=now
+    )
+    assert a["Authorization"] == b["Authorization"]
+    # a differing signed header (Range) must change the signature
+    c = signer.sign(
+        "GET",
+        "https://h/o?a=1&b=2",
+        {"x-custom": "a b", "Range": "bytes=0-9"},
+        now=now,
+    )
+    assert c["Authorization"] != b["Authorization"]
+    assert "range" in c["Authorization"]
+    # session tokens ride as a signed x-amz-security-token header
+    st = SigV4Signer("AK", "SK", session_token="TOK").sign(
+        "GET", "https://h/o", {}, now=now
+    )
+    assert st["X-Amz-Security-Token"] == "TOK"
+    assert "x-amz-security-token" in st["Authorization"]
+
+
+def test_signer_from_env():
+    assert signer_from_env({}) is None
+    s = signer_from_env(
+        {
+            "BEACON_S3_ACCESS_KEY": "AK",
+            "BEACON_S3_SECRET_KEY": "SK",
+            "BEACON_S3_REGION": "ap-southeast-2",
+        }
+    )
+    assert s is not None and s.region == "ap-southeast-2"
+    assert s.service == "s3"
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: private S3-compatible store that enforces SigV4
+# ---------------------------------------------------------------------------
+
+_OBJECT = bytes(range(256)) * 1024  # 256 KB
+
+
+class _SigV4Store(BaseHTTPRequestHandler):
+    """Verifies each request by recomputing the signature from the
+    received headers with the shared secret (how MinIO/AWS verify)."""
+
+    access_key = "AKIDEXAMPLE"
+    secret_key = SECRET
+    region = "us-east-1"
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def _verify(self) -> bool:
+        auth = self.headers.get("Authorization", "")
+        if not auth.startswith("AWS4-HMAC-SHA256 "):
+            return False
+        fields = dict(
+            part.strip().split("=", 1)
+            for part in auth[len("AWS4-HMAC-SHA256 "):].split(",")
+        )
+        cred = fields.get("Credential", "")
+        if not cred.startswith(self.access_key + "/"):
+            return False
+        signed = fields.get("SignedHeaders", "").split(";")
+        # rebuild the exact header set the client signed
+        hdrs = {}
+        for name in signed:
+            if name == "host":
+                hdrs["Host"] = self.headers.get("Host", "")
+            else:
+                val = self.headers.get(name)
+                if val is None:
+                    return False
+                hdrs[name] = val
+        signer = SigV4Signer(
+            self.access_key, self.secret_key, region=self.region
+        )
+        amz_date = self.headers.get("X-Amz-Date", "")
+        try:
+            now = time.strptime(amz_date, "%Y%m%dT%H%M%SZ")
+        except ValueError:
+            return False
+        want = signer.sign(
+            "GET",
+            f"http://{self.headers.get('Host', '')}{self.path}",
+            {k: v for k, v in hdrs.items() if k.lower() != "authorization"},
+            payload_hash=self.headers.get(
+                "X-Amz-Content-Sha256", "UNSIGNED-PAYLOAD"
+            ),
+            now=now,
+        )
+        want_sig = want["Authorization"].rsplit("Signature=", 1)[1]
+        return want_sig == fields.get("Signature")
+
+    def do_GET(self):  # noqa: N802
+        if not self._verify():
+            self.send_response(403)
+            self.end_headers()
+            return
+        rng = self.headers.get("Range")
+        body = _OBJECT
+        if rng and rng.startswith("bytes="):
+            a, _, b = rng[len("bytes="):].partition("-")
+            start, end = int(a), int(b) + 1
+            part = body[start:end]
+            self.send_response(206)
+            self.send_header(
+                "Content-Range", f"bytes {start}-{end - 1}/{len(body)}"
+            )
+            self.send_header("Content-Length", str(len(part)))
+            self.end_headers()
+            self.wfile.write(part)
+        else:
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+
+@pytest.fixture()
+def sigv4_store():
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _SigV4Store)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield f"127.0.0.1:{srv.server_address[1]}"
+    srv.shutdown()
+
+
+def test_signed_ranged_get_end_to_end(sigv4_store, monkeypatch):
+    from sbeacon_tpu.io.sources import HttpRangeSource, RemoteIOError
+
+    monkeypatch.setenv("BEACON_S3_ENDPOINT", f"http://{sigv4_store}")
+    monkeypatch.setenv("BEACON_S3_ACCESS_KEY", _SigV4Store.access_key)
+    monkeypatch.setenv("BEACON_S3_SECRET_KEY", _SigV4Store.secret_key)
+    monkeypatch.setenv("BEACON_S3_REGION", _SigV4Store.region)
+
+    src = HttpRangeSource(
+        "s3://bucket/key.bin", retries=0, chunk_bytes=64 * 1024
+    )
+    assert src.size() == len(_OBJECT)
+    assert src.read_range(10, 20) == _OBJECT[10:20]
+    # concurrent chunked path: every chunk signs its own Range request
+    got = src.read_range(0, len(_OBJECT), workers=4)
+    assert got == _OBJECT
+    # wrong secret -> the store rejects (403 surfaces as RemoteIOError)
+    monkeypatch.setenv("BEACON_S3_SECRET_KEY", "not-the-secret")
+    bad = HttpRangeSource("s3://bucket/key.bin", retries=0)
+    with pytest.raises(RemoteIOError):
+        bad.size()
+
+
+def test_unsigned_request_rejected(sigv4_store, monkeypatch):
+    # without credentials the bearer/anonymous path is used and the
+    # private store refuses it — proving the store's gate is real
+    from sbeacon_tpu.io.sources import HttpRangeSource, RemoteIOError
+
+    monkeypatch.setenv("BEACON_S3_ENDPOINT", f"http://{sigv4_store}")
+    monkeypatch.delenv("BEACON_S3_ACCESS_KEY", raising=False)
+    monkeypatch.delenv("BEACON_S3_SECRET_KEY", raising=False)
+    src = HttpRangeSource("s3://bucket/key.bin", retries=0)
+    with pytest.raises(RemoteIOError):
+        src.size()
