@@ -668,9 +668,12 @@ def pack_q8(
     q8 = (q & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
     needs_host = (
         ((enc["alt_mode"] == MODE_TYPE) & (enc["vt_code"] == VT_OTHER))
-        | (enc["ref_len"] > 0x1FFF)
+        # >= the clamp values (not >): the scattered kernel clamps the
+        # ROW length columns to the same widths, so a query sitting
+        # exactly at a clamp could otherwise hash-match a longer row
+        | (enc["ref_len"] >= 0x1FFF)
         | (enc["min_len"] > 0x1FFF)
-        | (enc["alt_len"] > 0xFFFF)  # could falsely match clamped len
+        | (enc["alt_len"] >= 0xFFFF)
         | (~unbounded & (enc["max_len"].astype(np.int64) > 0xFFFE))
     )
     return q8, needs_host

@@ -213,3 +213,76 @@ def test_merged_shard_chrom_native_union():
     )
     got = engine.search(payload)
     assert len(got) == 1 and got[0].exists
+
+
+def test_vectorized_materialize_matches_loop():
+    """The vectorised materialize_response must agree with the loop spec
+    on every (granularity, include_details, selected-samples) branch over
+    randomized matched-row sets, including ploidy>2 overflow entries."""
+    from sbeacon_tpu.engine import (
+        host_match_rows,
+        materialize_response,
+        materialize_response_loop,
+    )
+
+    rng = random.Random(97)
+    recs = random_records(
+        rng,
+        chrom="11",
+        n=400,
+        n_samples=9,
+        p_multiallelic=0.35,
+        p_symbolic=0.1,
+        p_no_acan=0.5,
+    )
+    # inject ploidy>2 genotypes so the overflow side-tables are non-empty
+    for rec in recs[::7]:
+        rec.genotypes[rng.randrange(9)] = "1|1|1"
+        rec.ac = None
+        rec.an = None
+    names = [f"S{i}" for i in range(9)]
+    shard = build_index(recs, dataset_id="vm", sample_names=names)
+    pos = shard.cols["pos"]
+    cases = 0
+    for trial in range(60):
+        p = int(pos[rng.randrange(len(pos))])
+        spec = QuerySpec(
+            "11",
+            max(1, p - rng.randint(0, 300)),
+            p + rng.randint(0, 300),
+            1,
+            1 << 30,
+            alternate_bases=rng.choice(["N", None, "T"]),
+            variant_type=rng.choice([None, "DEL", "CNV"]),
+        )
+        rows = host_match_rows(shard, spec)
+        for gran in ("boolean", "count", "record", "aggregated"):
+            for details in (True, False):
+                for sel in (None, [0, 3, 8], []):
+                    payload = VariantQueryPayload(
+                        dataset_ids=["vm"],
+                        reference_name="11",
+                        start_min=spec.start_min,
+                        start_max=spec.start_max,
+                        end_min=1,
+                        end_max=1 << 30,
+                        requested_granularity=gran,
+                        include_datasets="HIT" if details else "NONE",
+                        include_samples=True,
+                        selected_samples_only=sel is not None,
+                    )
+                    kw = dict(
+                        chrom_label="11",
+                        dataset_id="vm",
+                        selected_idx=sel,
+                    )
+                    want = materialize_response_loop(
+                        shard, rows, payload, **kw
+                    )
+                    got = materialize_response(shard, rows, payload, **kw)
+                    assert got == want, (
+                        f"trial={trial} gran={gran} details={details} "
+                        f"sel={sel}\n{got}\n{want}"
+                    )
+                    cases += 1
+    assert cases == 60 * 4 * 2 * 3
