@@ -418,16 +418,19 @@ def materialize_response(
 
     # per-row call contribution (the loop's rc)
     ac_rows = c["ac"][rows].astype(np.int64)
+    rc = ac_rows.copy()
     if count_planes:
-        info_ac = (c["flags"][rows] & FLAG.AC_INFO) != 0
-        gt_cnt = (
-            _popcounts(shard.gt_bits[rows], mask)
-            + _popcounts(shard.gt_bits2[rows], mask)
-            + _overflow_extras(shard, "gt", rows, sel_mask)
-        )
-        rc = np.where(info_ac, ac_rows, gt_cnt)
-    else:
-        rc = ac_rows
+        # popcount only the rows that actually use genotype-derived
+        # counts (INFO-sourced shards would otherwise pay full plane
+        # reads that np.where throws away)
+        gt_rows = np.flatnonzero((c["flags"][rows] & FLAG.AC_INFO) == 0)
+        if len(gt_rows):
+            rr = rows[gt_rows]
+            rc[gt_rows] = (
+                _popcounts(shard.gt_bits[rr], mask)
+                + _popcounts(shard.gt_bits2[rr], mask)
+                + _overflow_extras(shard, "gt", rr, sel_mask)
+            )
 
     rc_grp = np.add.reduceat(rc, starts)
     cum = np.cumsum(rc_grp)
@@ -438,13 +441,14 @@ def materialize_response(
     r0 = rows[starts]
     an_grp = c["an"][r0].astype(np.int64)
     if count_planes:
-        info_an = (c["flags"][r0] & FLAG.AN_INFO) != 0
-        tok_cnt = (
-            _popcounts(shard.tok_bits1[r0], mask)
-            + _popcounts(shard.tok_bits2[r0], mask)
-            + _overflow_extras(shard, "tok", r0, sel_mask)
-        )
-        an_grp = np.where(info_an, an_grp, tok_cnt)
+        tok_grps = np.flatnonzero((c["flags"][r0] & FLAG.AN_INFO) == 0)
+        if len(tok_grps):
+            rr = r0[tok_grps]
+            an_grp[tok_grps] = (
+                _popcounts(shard.tok_bits1[rr], mask)
+                + _popcounts(shard.tok_bits2[rr], mask)
+                + _overflow_extras(shard, "tok", rr, sel_mask)
+            )
 
     # cumulative truncation: which records the loop would process
     if not exists:

@@ -158,6 +158,30 @@ from .kernel import (  # noqa: E402
 )
 
 
+def stage_symbolic_flags(
+    flags: np.ndarray, alt_prefix: np.ndarray
+) -> np.ndarray:
+    """Return ``flags`` with the PM_* symbolic-prefix bits staged from
+    the 16-byte alt prefixes — the device-matrix-only bits both the
+    grouped and scattered index builders need ('<DEL'/'<DUP' reuse the
+    shard's own FLAG bits; these cover the rest). One shared
+    implementation so the two kernels can never drift on prefix
+    semantics."""
+    from ..index.columnar import pack_prefix16, prefix_mask
+
+    out = flags.astype(np.int64, copy=True)
+    for prefix, bit in (
+        (b"<INS", PM_INS),
+        (b"<DUP:TANDEM", PM_DUPT),
+        (b"<CNV", PM_CNV),
+    ):
+        want = pack_prefix16(prefix)
+        m = prefix_mask(min(len(prefix), 16))
+        hit = (((alt_prefix ^ want) & m) == 0).all(axis=1)
+        out |= np.where(hit, np.int64(bit), 0)
+    return out
+
+
 class PallasDeviceIndex:
     """One shard's columns stacked as an int32 ``[16, L]`` device matrix.
 
@@ -186,20 +210,11 @@ class PallasDeviceIndex:
         mat[ROW_AP + 4 :, :] = 0
         # stage the symbolic-prefix bits the grouped kernel needs (the
         # shard's persisted flags are untouched — these live only in the
-        # device matrix): computed from the alt_prefix words exactly as
-        # the legacy kernel's vprefix compare did
-        apu = shard.cols["alt_prefix"]  # [n, 4] uint32
-        from ..index.columnar import pack_prefix16, prefix_mask
-
-        for prefix, bit in (
-            (b"<INS", PM_INS),
-            (b"<DUP:TANDEM", PM_DUPT),
-            (b"<CNV", PM_CNV),
-        ):
-            want = pack_prefix16(prefix)
-            m = prefix_mask(min(len(prefix), 16))
-            hit = (((apu ^ want) & m) == 0).all(axis=1)
-            mat[ROW_FLAGS, :n] |= np.where(hit, np.int32(bit), np.int32(0))
+        # device matrix), via the staging helper shared with the
+        # scattered kernel
+        mat[ROW_FLAGS, :n] = stage_symbolic_flags(
+            mat[ROW_FLAGS, :n], shard.cols["alt_prefix"]
+        ).astype(np.int32)
         self.shard = shard
         self.n_rows = n
         self.n_lanes = L

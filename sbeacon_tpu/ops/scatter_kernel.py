@@ -149,20 +149,9 @@ class ScatterDeviceIndex:
             np.minimum(c["ref_len"].astype(np.int64), _REF_LEN_CLAMP) << 16
         )
         fill(P_LENS, lens.astype(np.int64).astype(np.int32), 0)
-        flags = c["flags"].astype(np.int64)
-        # stage the symbolic-prefix bits exactly as PallasDeviceIndex
-        from ..index.columnar import pack_prefix16, prefix_mask
+        from .pallas_kernel import stage_symbolic_flags
 
-        apu = c["alt_prefix"]
-        for prefix, bit in (
-            (b"<INS", PM_INS),
-            (b"<DUP:TANDEM", PM_DUPT),
-            (b"<CNV", PM_CNV),
-        ):
-            want = pack_prefix16(prefix)
-            m = prefix_mask(min(len(prefix), 16))
-            hit = (((apu ^ want) & m) == 0).all(axis=1)
-            flags |= np.where(hit, np.int64(bit), 0)
+        flags = stage_symbolic_flags(c["flags"], c["alt_prefix"])
         k1 = np.clip(c["ref_repeat_k"].astype(np.int64) + 1, 0, 127)
         flags |= k1 << 19
         clamped = (c["ref_len"].astype(np.int64) > _REF_LEN_CLAMP) | (

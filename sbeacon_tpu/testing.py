@@ -303,7 +303,7 @@ def synthetic_shard(
     is_indel = (~is_sym) & (kind < p_symbolic + p_indel)
     alt_id = np.where(
         is_sym,
-        rng.integers(64, 64 + 5, n),
+        rng.integers(64, 64 + 6, n),
         np.where(is_indel, rng.integers(4, 64, n), rng.integers(0, 4, n)),
     )
     ref_id = np.repeat(
