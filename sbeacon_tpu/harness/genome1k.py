@@ -22,7 +22,6 @@ deleted as soon as its shard is persisted.
 from __future__ import annotations
 
 import json
-import random
 import time
 from pathlib import Path
 
@@ -197,7 +196,7 @@ def build_corpus(
     """
     from ..config import BeaconConfig, StorageConfig
     from ..genomics.tabix import ensure_index
-    from ..index.columnar import load_index, save_index
+    from ..index.columnar import save_index
     from ..ingest.pipeline import SummarisationPipeline
 
     root = Path(root)

@@ -66,16 +66,22 @@ def _predicate(column: str, op: str, numeric: bool) -> str:
     return f"{column} {op} ?"
 
 
-def entity_search_conditions(
+def entity_search_parts(
     filters: list[dict],
     id_type: str,
     default_scope: str,
     *,
     ontology: OntologyStore | None = None,
-    id_modifier: str = "id",
-    with_where: bool = True,
-) -> tuple[str, list[str]]:
-    """(sql_fragment, params) constraining ``id_type`` rows by ``filters``."""
+):
+    """Classify filters into structured SQL parts — the single source of
+    truth for filter semantics: (outer_predicates, outer_params,
+    join_subqueries, join_params, relation_id_column).
+
+    ``entity_search_conditions`` assembles the reference-shaped WHERE
+    fragment from these; the store's shape-specific fast paths (e.g.
+    streaming ``exists``) consume them directly, so the two can never
+    disagree on classification.
+    """
     if id_type not in ENTITY_COLUMNS:
         raise FilterError(f"unknown id_type {id_type!r}")
     own_columns = ENTITY_COLUMNS[id_type]
@@ -130,7 +136,24 @@ def entity_search_conditions(
             f"JOIN terms_index TI ON RI.{RELATION_ID_COLUMN[scope]} = TI.id "
             f"WHERE TI.kind = '{scope}' AND TI.term IN ({placeholders})"
         )
+    return outer_predicates, outer_params, join_subqueries, join_params, my_rel
 
+
+def entity_search_conditions(
+    filters: list[dict],
+    id_type: str,
+    default_scope: str,
+    *,
+    ontology: OntologyStore | None = None,
+    id_modifier: str = "id",
+    with_where: bool = True,
+) -> tuple[str, list[str]]:
+    """(sql_fragment, params) constraining ``id_type`` rows by ``filters``."""
+    outer_predicates, outer_params, join_subqueries, join_params, _ = (
+        entity_search_parts(
+            filters, id_type, default_scope, ontology=ontology
+        )
+    )
     clauses: list[str] = []
     if join_subqueries:
         joined = " INTERSECT ".join(join_subqueries)

@@ -183,12 +183,19 @@ def sharded_query(
     axis: str = AXIS,
     window_cap: int = 2048,
     record_cap: int = 1024,
+    aggregates_only: bool = False,
 ):
     """Run a query batch against a mesh-sharded dataset stack.
 
     Returns (per_dataset, aggregates) as numpy: per_dataset leaves are
     [D, B, ...] (D = padded dataset count), aggregates are [B]-shaped
     cross-dataset reductions computed with psum over the mesh.
+
+    ``aggregates_only`` skips fetching the dataset-sharded leaves —
+    REQUIRED under multi-controller ``jax.distributed``, where a process
+    can only device_get fully-addressable arrays: the psum aggregates
+    are replicated (addressable everywhere) while per-dataset results
+    live on their owning hosts.
     """
     enc = (
         encode_queries(queries) if isinstance(queries, list) else queries
@@ -196,12 +203,13 @@ def sharded_query(
     enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
     fn = _build_sharded_fn(mesh, axis, window_cap, record_cap, n_iters)
     per_ds, agg = fn(stacked_arrays, enc_dev)
-    per_ds = jax.device_get(per_ds)
     agg = jax.device_get(agg)
-    return (
-        {k: np.asarray(v) for k, v in per_ds.items()},
-        {k: np.asarray(v) for k, v in agg.items()},
-    )
+    if aggregates_only:
+        per_out: dict = {}
+    else:
+        per_ds = jax.device_get(per_ds)
+        per_out = {k: np.asarray(v) for k, v in per_ds.items()}
+    return per_out, {k: np.asarray(v) for k, v in agg.items()}
 
 
 def aggregate_struct(agg: dict) -> dict:

@@ -80,6 +80,11 @@ class MicroBatcher:
     def __init__(self, *, max_batch: int = 512, max_wait_ms: float = 2.0):
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        # occupancy accounting (the soak harness's evidence that
+        # batching engages under concurrency): {batch_size: n_launches}
+        self._stats_lock = threading.Lock()
+        self._batch_hist: dict[int, int] = {}
+        self._n_submits = 0
         # weak-keyed by the DeviceIndex so accumulators die with their
         # index (re-ingestion replaces DeviceIndex objects; an id()-keyed
         # dict would leak one accumulator per replaced index and could
@@ -113,6 +118,8 @@ class MicroBatcher:
         batched QueryResults."""
         acc = self._accum(dindex, (window_cap, record_cap))
         me = _Pending(spec=spec, event=threading.Event())
+        with self._stats_lock:
+            self._n_submits += 1
 
         with acc.lock:
             acc.items.append(me)
@@ -176,8 +183,26 @@ class MicroBatcher:
                     p.event.set()
             raise
 
+    def occupancy(self) -> dict:
+        """{'submits': N, 'launches': M, 'mean_batch': x, 'histogram':
+        {size: count}} — cumulative since construction."""
+        with self._stats_lock:
+            hist = dict(sorted(self._batch_hist.items()))
+            launches = sum(hist.values())
+            total = sum(k * v for k, v in hist.items())
+            return {
+                "submits": self._n_submits,
+                "launches": launches,
+                "mean_batch": round(total / launches, 2) if launches else 0.0,
+                "histogram": hist,
+            }
+
     def _execute(self, batch, dindex, window_cap, record_cap):
         specs = [p.spec for p in batch]
+        with self._stats_lock:
+            self._batch_hist[len(specs)] = (
+                self._batch_hist.get(len(specs), 0) + 1
+            )
         try:
             with span("serving.microbatch") as sp:
                 enc = encode_queries(specs)

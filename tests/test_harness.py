@@ -47,3 +47,24 @@ def test_latency_suite_all_green(live):
     # every check ran and returned a sane latency
     assert len(results) >= 18
     assert all(0 <= t < 30 for t in results.values())
+
+
+def test_metadata_scale_harness_small(tmp_path):
+    """The 1M-individual harness at toy scale: bulk path seeds linked
+    entities the filter compiler can see (sex filter, ontology-expanded
+    phenotype, cross-entity joins) through the real route handlers."""
+    from sbeacon_tpu.harness.scale import run_metadata_scale
+
+    rep = run_metadata_scale(tmp_path, n_datasets=5, individuals_per=30)
+    assert rep["populate"]["individuals"] == 150
+    assert rep["relations_rows"] >= 150
+    # the ontology-expanded count must actually match individuals
+    assert rep["queries"]["ontology_count_result"] > 0
+    for key in (
+        "individuals_sex_boolean",
+        "individuals_sex_count",
+        "individuals_sex_record",
+        "individuals_ontology_count",
+        "dataset_individuals_record",
+    ):
+        assert rep["queries"][key]["p50_ms"] > 0
