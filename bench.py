@@ -1,41 +1,45 @@
-"""Headline benchmark + BASELINE.md config suite.
+"""Headline benchmark + BASELINE.md config suite — 1000-Genomes scale.
 
-Prints ONE JSON line. The headline metric is BASELINE config 2 ("10k
-batched SNV point queries, single dataset" on one chip); the other four
-configs from BASELINE.md ride in ``detail``:
-
-  1. single SNV exists-query latency (p50) + allele-count parity vs the
-     CPU oracle (the performQuery-equivalent semantics spec),
-  2. 10k batched point queries (headline),
-  3. start-end bracket/range queries across chr1..22,
-  4. multi-dataset aggregation (dataset-sharded engine fan-in + distinct
-     variant parity),
-  5. structural-variant / INDEL overlap queries (variantType matching).
+Prints ONE JSON line. Round-3 rework (VERDICT r2 #1): every query config
+runs against a 1000-Genomes-shaped corpus — >=2e7 index rows across
+chr1-22 at real length proportions with 2504-sample-wide genotype
+planes — instead of round 2's <=101k-row toy. The headline metric is
+BASELINE config 2 (10k batched SNV point queries on one chip); detail
+carries the other configs, a v5e roofline statement, skew-distribution
+spreads, a selected-samples config at full sample width, a concurrent
+HTTP soak with micro-batcher occupancy, and the real-pipeline ingest
+probe (plus the out-of-band INGEST_r03.json full-corpus manifest).
 
 Baseline derivation (the reference publishes no numbers — BASELINE.md):
 the reference answers each point query with a splitQuery->performQuery
-lambda chain whose concurrency ceiling is 1000 lambdas
-(reference: lambda/summariseVcf/lambda_function.py:25 MAX_CONCURRENCY;
-variantutils/search_variants.py THREADS=500) and whose per-query
-end-to-end latency is ~1 s (bcftools region scan + invoke overhead at the
-reference's assumed 75 MB/s scan rate, summariseVcf:23). Ceiling ~= 1000
-queries/sec. ``vs_baseline`` is measured-qps / 1000.
+lambda chain whose concurrency ceiling is 1000 lambdas and per-query
+latency ~1 s (bcftools region scan at the reference's assumed 75 MB/s),
+so its ceiling ~= 1000 queries/sec. ``vs_baseline`` is measured-qps/1000.
+
+Scale knobs: BENCH_ROWS (default 20_000_000) and BENCH_SAMPLES (default
+2504) — the driver's run uses the defaults; smaller values exist for
+smoke-testing the bench itself, and the emitted detail always reports
+the sizes actually used (nothing shrinks silently).
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
 import sys
 import time
 import traceback
 
-N_RECORDS = 60_000
+N_ROWS = int(os.environ.get("BENCH_ROWS", 20_000_000))
+N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 2504))
 N_QUERIES = 10_000
-# min-of-N absorbs the remote-chip tunnel's RTT jitter (observed 65-90k
-# qps spread at N=5); marginal cost ~0.15 s/repeat
-REPEATS = 8
+REPEATS = 6
 BASELINE_QPS = 1000.0
+
+# v5e (this box reports 'TPU v5 lite'): 16 GB HBM2 @ 819 GB/s peak,
+# 197 bf16 TFLOP/s — the public spec sheet numbers the roofline uses
+V5E_HBM_PEAK_GBPS = 819.0
 
 ALL_CHROMS = [str(i) for i in range(1, 23)]
 
@@ -52,8 +56,7 @@ def _time_batch(fn, repeats=REPEATS):
 def _pipelined_qps(fn, n_queries, *, reps=16, threads=8, rounds=2):
     """Sustained queries/s with overlapped in-flight batches (each sync
     through the tunnel costs a full RTT, so serial timing understates a
-    concurrent server's throughput). Best of ``rounds`` measurements —
-    the tunnel's load jitter hits one-shot pipelined numbers hard."""
+    concurrent server's throughput)."""
     from concurrent.futures import ThreadPoolExecutor
 
     best = 0.0
@@ -68,215 +71,202 @@ def _pipelined_qps(fn, n_queries, *, reps=16, threads=8, rounds=2):
 
 
 def build_corpus():
-    from sbeacon_tpu.index.columnar import build_index
-    from sbeacon_tpu.testing import random_records
+    """The 1000-Genomes-shaped serving corpus: chr1-22, N_ROWS rows,
+    N_SAMPLES-wide genotype planes (plane_density=0.25 keeps the build
+    to two RNG passes; denser-than-real planes make the popcount paths
+    a conservative measurement, never a flattering one)."""
+    from sbeacon_tpu.testing import synthetic_shard
 
-    rng = random.Random(7)
-    records = []
-    for chrom in ("1", "22"):
-        records.extend(
-            random_records(
-                rng, chrom=chrom, n=N_RECORDS // 2, n_samples=8, spacing=40
-            )
-        )
-    shard = build_index(records, dataset_id="bench", with_genotypes=False)
-    return records, shard
-
-
-def _timed_best(shard, dindex, enc, ref_results, *, window, measure_pipelined=True):
-    """(best_s, kernel_name, extra): time the grouped Pallas kernel when
-    available and exact vs the XLA reference (non-overflow rows equal,
-    no fallback needed on bench workloads); otherwise the XLA gather
-    kernel. ``extra`` carries the device-only probe — serialized
-    on-device seconds per batch and effective HBM scan bandwidth — so
-    tunnel RTT and kernel time are never conflated (VERDICT r1 #6)."""
-    from sbeacon_tpu.ops.kernel import run_queries
-
-    try:
-        from sbeacon_tpu.ops import HAVE_PALLAS
-        from sbeacon_tpu.ops.pallas_kernel import (
-            PallasDeviceIndex,
-            device_time_probe,
-            run_queries_grouped,
-        )
-
-        if HAVE_PALLAS:
-            pindex = PallasDeviceIndex(shard, window=window)
-            got = run_queries_grouped(
-                pindex, enc, window_cap=window, record_cap=64, with_rows=False
-            )  # warm-up + parity guard
-            ok = ~got.overflow
-            parity = (
-                (got.overflow | ~ref_results.overflow).all()
-                and (got.exists[ok] == ref_results.exists[ok]).all()
-                and (got.call_count[ok] == ref_results.call_count[ok]).all()
-                and (got.n_variants[ok] == ref_results.n_variants[ok]).all()
-                and (
-                    got.all_alleles_count[ok]
-                    == ref_results.all_alleles_count[ok]
-                ).all()
-                and ok.all()  # bench workloads must not need host fallback
-            )
-            if parity:
-                best = _time_batch(
-                    lambda: run_queries_grouped(
-                        pindex,
-                        enc,
-                        window_cap=window,
-                        record_cap=64,
-                        with_rows=False,
-                    )
-                )
-                extra = {"_pindex": pindex}  # reuse: device matrix upload
-                if measure_pipelined:
-                    # optional metric: must not discard the validated
-                    # pallas result on a transient tunnel error
-                    try:
-                        extra["pipelined_qps"] = round(
-                            _pipelined_qps(
-                                lambda: run_queries_grouped(
-                                    pindex,
-                                    enc,
-                                    window_cap=window,
-                                    record_cap=64,
-                                    with_rows=False,
-                                ),
-                                len(got.exists),
-                            ),
-                            1,
-                        )
-                    except Exception:
-                        traceback.print_exc(file=sys.stderr)
-                try:
-                    # iters is the differencing-chain delta: at ~0.25
-                    # ms/batch device time, 128 serialized batches give a
-                    # ~30 ms signal vs ~1-3 ms of tunnel RTT jitter
-                    dev_s, scanned = device_time_probe(
-                        pindex, enc, window_cap=window, iters=128
-                    )
-                    extra.update(
-                        device_ms_per_batch=round(dev_s * 1e3, 3),
-                        device_qps=round(len(got.exists) / dev_s, 1),
-                        scan_gb_per_s=round(scanned / dev_s / 1e9, 1),
-                    )
-                except Exception:
-                    traceback.print_exc(file=sys.stderr)
-                return best, "pallas", extra
-            print(
-                "bench: pallas kernel failed parity guard; using xla",
-                file=sys.stderr,
-            )
-    except Exception:
-        traceback.print_exc(file=sys.stderr)
-        print("bench: pallas path unavailable; using xla", file=sys.stderr)
-    best = _time_batch(
-        lambda: run_queries(dindex, enc, window_cap=window, record_cap=64)
+    t0 = time.perf_counter()
+    shard = synthetic_shard(
+        N_ROWS,
+        n_samples=N_SAMPLES,
+        with_gt_planes=True,
+        plane_density=0.25,
+        seed=11,
+        dataset_id="bench1kg",
     )
-    return best, "xla", {}
+    build_s = time.perf_counter() - t0
+    return shard, build_s
 
 
-def config2_point_queries(shard):
-    """Headline: 10k batched point queries, single chip.
+def _point_specs(shard, n, seed=5, miss_every=2):
+    from sbeacon_tpu.ops.kernel import QuerySpec
 
-    The timed path is the Pallas window-scan kernel (contiguous DMA per
-    query window); the XLA gather kernel rides along as ``xla_qps`` for
-    comparison and as fallback where pallas is unavailable.
-    """
-    from sbeacon_tpu.ops.kernel import (
-        DeviceIndex,
-        QuerySpec,
-        encode_queries,
-        run_queries,
-    )
-
-    dindex = DeviceIndex(shard)
-    qrng = random.Random(11)
+    rng = random.Random(seed)
+    pos = shard.cols["pos"]
     specs = []
-    n_rows = shard.n_rows
-    for i in range(N_QUERIES):
-        if i % 2 == 0:
-            r = qrng.randrange(n_rows)
-            pos = int(shard.cols["pos"][r])
+    for i in range(n):
+        if i % miss_every:
+            p = rng.randrange(1, 3_000_000)
+            specs.append(
+                QuerySpec("1", p, p, 1, 2**30, alternate_bases="T")
+            )
+        else:
+            r = rng.randrange(shard.n_rows)
+            p = int(pos[r])
             specs.append(
                 QuerySpec(
                     shard.row_chrom(r),
-                    pos,
-                    pos,
+                    p,
+                    p,
                     1,
                     2**30,
                     reference_bases=shard.row_ref(r),
                     alternate_bases=shard.row_alt(r),
                 )
             )
-        else:
-            pos = qrng.randrange(1, 3_000_000)
-            specs.append(
-                QuerySpec("1", pos, pos, 1, 2**30, alternate_bases="T")
-            )
-    enc = encode_queries(specs)
-    res = run_queries(dindex, enc, window_cap=512, record_cap=64)  # warm-up
-    best_xla = _time_batch(
-        lambda: run_queries(dindex, enc, window_cap=512, record_cap=64)
+    return specs
+
+
+def _scale_parity(shard, sindex, enc, res, n_check=300):
+    """Allele-count parity at corpus scale: the device answers for a
+    random sample of queries must equal the uncapped host matcher
+    (engine.host_match_rows — byte-exact alleles, no caps)."""
+    import numpy as np
+
+    from sbeacon_tpu.engine import host_match_rows
+    from sbeacon_tpu.ops.kernel import QuerySpec  # noqa: F401
+
+    rng = random.Random(17)
+    idx = [rng.randrange(len(res.exists)) for _ in range(n_check)]
+    ok = 0
+    for i in idx:
+        if res.overflow[i]:
+            ok += 1  # host path answers by definition
+            continue
+        spec = enc["_specs"][i]
+        rows = host_match_rows(shard, spec)
+        ac = shard.cols["ac"][rows]
+        want_call = int(ac.sum())
+        recs = shard.cols["rec_id"][rows]
+        first = np.unique(recs, return_index=True)[1] if len(rows) else []
+        want_alleles = int(shard.cols["an"][rows[first]].sum()) if len(rows) else 0
+        if (
+            int(res.call_count[i]) == want_call
+            and int(res.all_alleles_count[i]) == want_alleles
+            and bool(res.exists[i]) == (want_call > 0)
+        ):
+            ok += 1
+    return f"{ok}/{n_check}"
+
+
+def config2_point_queries(shard, sindex):
+    """Headline: 10k batched point queries at 2e7 rows, single chip."""
+    from sbeacon_tpu.ops.kernel import encode_queries
+    from sbeacon_tpu.ops.scatter_kernel import (
+        device_time_probe,
+        run_queries_scattered,
     )
-    best, kernel, extra = _timed_best(
-        shard, dindex, enc, res, window=512, measure_pipelined=False
-    )  # config2 runs its own (larger) pipelined measurement below
-    pindex = extra.pop("_pindex", None)
+
+    specs = _point_specs(shard, N_QUERIES)
+    enc = encode_queries(specs)
+    enc["_specs"] = specs  # parity sampling
+
+    def agg():
+        return run_queries_scattered(
+            sindex, enc, window_cap=512, record_cap=64, with_rows=False
+        )
+
+    def rec():
+        return run_queries_scattered(
+            sindex, enc, window_cap=512, record_cap=64, with_rows=True
+        )
+
+    res = agg()  # warm-up/compile
     detail = {
         "hits": int(res.exists.sum()),
-        "xla_qps": round(N_QUERIES / best_xla, 1),
-        "kernel": kernel,
-        "best_batch_s": round(best, 4),
-        "serial_qps": round(N_QUERIES / best, 1),
-        **extra,
+        "overflow": int(res.overflow.sum()),
+        "scale_parity": _scale_parity(shard, sindex, enc, res),
     }
-    headline = N_QUERIES / best
-    if kernel == "pallas" and pindex is not None:
-        from sbeacon_tpu.ops.pallas_kernel import run_queries_grouped
-
-        # sustained throughput: overlapped in-flight batches amortise the
-        # host<->device round trips exactly as concurrent serving does
-        # (through the tunnel each sync costs a full RTT; BASELINE.md)
-        def one(with_rows):
-            return run_queries_grouped(
-                pindex,
-                enc,
-                window_cap=512,
-                record_cap=64,
-                with_rows=with_rows,
-            )
-
-        piped = _pipelined_qps(lambda: one(False), N_QUERIES, reps=24)
-        headline = max(headline, piped)
-        detail["pipelined_qps"] = round(piped, 1)
-        # record granularity: in-kernel row materialisation (packed match
-        # masks) instead of the XLA gather kernel (VERDICT r1 weak #2)
-        one(True)
-        best_rec = _time_batch(lambda: one(True), repeats=4)
-        detail["record_serial_qps"] = round(N_QUERIES / best_rec, 1)
-        detail["record_pipelined_qps"] = round(
-            _pipelined_qps(lambda: one(True), N_QUERIES), 1
+    best = _time_batch(agg)
+    detail["serial_qps"] = round(N_QUERIES / best, 1)
+    piped = _pipelined_qps(agg, N_QUERIES, reps=24)
+    detail["pipelined_qps"] = round(piped, 1)
+    rec()  # warm
+    best_rec = _time_batch(rec, repeats=4)
+    detail["record_serial_qps"] = round(N_QUERIES / best_rec, 1)
+    detail["record_pipelined_qps"] = round(
+        _pipelined_qps(rec, N_QUERIES), 1
+    )
+    try:
+        per, gathered = device_time_probe(
+            sindex, enc, window_cap=128, iters=256
         )
+        qps_dev = 2048 / per
+        gbps = gathered / per / 1e9
+        detail.update(
+            device_us_per_2048=round(per * 1e6, 2),
+            device_qps=round(qps_dev, 1),
+            gather_gb_per_s=round(gbps, 1),
+            roofline_fraction=round(gbps / V5E_HBM_PEAK_GBPS, 3),
+        )
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    headline = max(piped, N_QUERIES / best)
     return headline, detail
 
 
-def config1_single_snv(records, shard):
-    """Single SNV exists-query p50 latency + oracle parity."""
+def config1_single_snv(shard, sindex):
+    """Single SNV exists-query p50 through the engine + oracle parity
+    (the parity oracle runs on a small independent record corpus —
+    VcfRecord-level oracles cannot hold 2e7 records in Python; scale
+    parity against the host matcher rides in config2)."""
     from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.index.columnar import build_index
     from sbeacon_tpu.oracle import oracle_search
     from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
 
-    engine = VariantEngine()
-    engine.add_index(shard)
+    engine = VariantEngine(
+        BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
+    )
+    engine.add_prebuilt_index(shard, sindex)
+    import numpy as np
+
+    from sbeacon_tpu.index.columnar import FLAG
+
     rng = random.Random(23)
-    hits = [r for r in records if not r.alts[0].startswith("<")]
+    pos = shard.cols["pos"]
+    # alternateBases='N' matches single-base alts only: query those rows
+    sb = np.flatnonzero(shard.cols["flags"] & FLAG.SINGLE_BASE)
     lat = []
+    for _ in range(30):
+        r = int(sb[rng.randrange(len(sb))])
+        payload = VariantQueryPayload(
+            dataset_ids=["bench1kg"],
+            reference_name=shard.row_chrom(r),
+            start_min=int(pos[r]),
+            start_max=int(pos[r]),
+            end_min=1,
+            end_max=2**30,
+            alternate_bases="N",
+            requested_granularity="record",
+            include_datasets="HIT",
+        )
+        t0 = time.perf_counter()
+        got = engine.search(payload)
+        lat.append(time.perf_counter() - t0)
+        assert got and got[0].exists
+    lat.sort()
+    out = {"p50_ms": round(lat[len(lat) // 2] * 1000, 3)}
+
+    # oracle parity on an independent small corpus (true VcfRecord oracle)
+    orng = random.Random(7)
+    recs = random_records(orng, chrom="22", n=3000, n_samples=8)
+    oshard = build_index(recs, dataset_id="oracle")
+    oeng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
+    )
+    oeng.add_index(oshard)
+    hits = [r for r in recs if not r.alts[0].startswith("<")]
     parity_ok = 0
     n_checks = 40
     for _ in range(n_checks):
-        rec = rng.choice(hits)
+        rec = orng.choice(hits)
         payload = VariantQueryPayload(
-            dataset_ids=["bench"],
+            dataset_ids=["oracle"],
             reference_name=rec.chrom,
             start_min=rec.pos,
             start_max=rec.pos,
@@ -287,11 +277,9 @@ def config1_single_snv(records, shard):
             requested_granularity="record",
             include_datasets="HIT",
         )
-        t0 = time.perf_counter()
-        got = engine.search(payload)
-        lat.append(time.perf_counter() - t0)
+        got = oeng.search(payload)
         want = oracle_search(
-            records,
+            recs,
             first_bp=rec.pos,
             last_bp=rec.pos,
             end_min=1,
@@ -300,7 +288,7 @@ def config1_single_snv(records, shard):
             alternate_bases=rec.alts[0].upper(),
             requested_granularity="record",
             include_details=True,
-            dataset_id="bench",
+            dataset_id="oracle",
             chrom_label=rec.chrom,
         )
         if (
@@ -310,260 +298,211 @@ def config1_single_snv(records, shard):
             and got[0].all_alleles_count == want.all_alleles_count
         ):
             parity_ok += 1
-    lat.sort()
-    out = {
-        "p50_ms": round(lat[len(lat) // 2] * 1000, 3),
-        "allele_count_parity": f"{parity_ok}/{n_checks}",
-    }
-    # co-located serving-stack p50: the same engine.search path on an
-    # in-process CPU backend (no tunnel) — evidences that end-to-end p50
-    # minus the tunnel is well under the <10 ms north-star even before
-    # device speed enters (full python serving stack + kernel)
+    out["allele_count_parity"] = f"{parity_ok}/{n_checks}"
+
+    # co-located full-stack p50 on the CPU backend (no tunnel): evidences
+    # the <10 ms north-star is transport-bound, not framework-bound
     try:
-        import os
         import subprocess
 
         proc = subprocess.run(
             [sys.executable, "-c", _COLOCATED_PROBE],
             capture_output=True,
             text=True,
-            timeout=240,
-            # belt AND braces with the probe's in-script config.update:
-            # this box's profile pins an axon platform that must not
-            # initialise before the probe forces cpu
+            timeout=300,
             env={**os.environ, "JAX_PLATFORMS": "cpu"},
         )
         lines = proc.stdout.strip().splitlines()
         line = lines[-1] if lines else ""
         if line.startswith("p50_ms="):
-            _colocated = round(float(line.split("=", 1)[1]), 3)
+            out["colocated_cpu_p50_ms"] = round(float(line.split("=")[1]), 3)
         else:
-            _colocated = None
             print(proc.stderr[-500:], file=sys.stderr)
     except Exception:
-        _colocated = None
         traceback.print_exc(file=sys.stderr)
-
-    # device-only single-query time: p50 above includes the host->device
-    # round trip (~65 ms RTT each way through the tunnel, BASELINE.md);
-    # this separates the kernel's share so the <10 ms north-star is
-    # evidenced rather than asserted (VERDICT r1 #6)
-    try:
-        from sbeacon_tpu.ops import HAVE_PALLAS
-        from sbeacon_tpu.ops.pallas_kernel import (
-            PallasDeviceIndex,
-            device_time_probe,
-        )
-        from sbeacon_tpu.ops.kernel import QuerySpec
-
-        if HAVE_PALLAS:
-            pindex = PallasDeviceIndex(shard, window=512)
-            rec = hits[0]
-            spec = QuerySpec(
-                rec.chrom,
-                rec.pos,
-                rec.pos,
-                1,
-                2**30,
-                reference_bases=rec.ref.upper(),
-                alternate_bases=rec.alts[0].upper(),
-            )
-            # a single query is one grid step (~2.7 us measured on v5e,
-            # BASELINE.md config1): the chain must be very long for the
-            # differencing signal to rise above RTT jitter
-            dev_s, _ = device_time_probe(
-                pindex, [spec], window_cap=512, iters=16384
-            )
-            out["device_ms"] = round(dev_s * 1e3, 4)
-    except Exception:
-        traceback.print_exc(file=sys.stderr)
-    if _colocated is not None:
-        out["colocated_cpu_p50_ms"] = _colocated
     return out
 
 
-# runs in a subprocess with JAX_PLATFORMS=cpu: full engine.search stack,
-# no tunnel — p50 over 40 single queries after warm-up
 _COLOCATED_PROBE = """
 import jax
 jax.config.update("jax_platforms", "cpu")
 import random, time
 from sbeacon_tpu.config import BeaconConfig, EngineConfig
 from sbeacon_tpu.engine import VariantEngine
-from sbeacon_tpu.index.columnar import build_index
 from sbeacon_tpu.payloads import VariantQueryPayload
-from sbeacon_tpu.testing import random_records
+from sbeacon_tpu.testing import synthetic_shard
 
-rng = random.Random(7)
-records = []
-for chrom in ("1", "22"):
-    records.extend(random_records(rng, chrom=chrom, n=30000, n_samples=8, spacing=40))
-shard = build_index(records, dataset_id="bench", with_genotypes=False)
+shard = synthetic_shard(2_000_000, n_samples=16, seed=7, dataset_id="co")
 engine = VariantEngine(BeaconConfig(engine=EngineConfig(use_mesh=False)))
 engine.add_index(shard)
-qrng = random.Random(23)
-hits = [r for r in records if not r.alts[0].startswith("<")]
+rng = random.Random(23)
+pos = shard.cols["pos"]
 lat = []
 for i in range(45):
-    rec = qrng.choice(hits)
+    r = rng.randrange(shard.n_rows)
     payload = VariantQueryPayload(
-        dataset_ids=["bench"], reference_name=rec.chrom,
-        start_min=rec.pos, start_max=rec.pos, end_min=1, end_max=2**30,
-        reference_bases=rec.ref.upper(), alternate_bases=rec.alts[0].upper(),
+        dataset_ids=["co"], reference_name=shard.row_chrom(r),
+        start_min=int(pos[r]), start_max=int(pos[r]), end_min=1, end_max=2**30,
+        alternate_bases="N",
         requested_granularity="record", include_datasets="HIT")
     t0 = time.perf_counter()
     engine.search(payload)
-    if i >= 5:  # skip warm-up/compile
+    if i >= 5:
         lat.append(time.perf_counter() - t0)
 lat.sort()
 print(f"p50_ms={lat[len(lat)//2]*1e3:.3f}")
 """
 
 
-def config3_bracket_ranges():
-    """Bracket/range queries across chr1..22 (own whole-genome corpus)."""
-    from sbeacon_tpu.index.columnar import build_index
-    from sbeacon_tpu.ops.kernel import (
-        DeviceIndex,
-        QuerySpec,
-        encode_queries,
-        run_queries,
+def config3_brackets(shard, sindex):
+    """10 kb bracket/range queries across chr1-22 at 2e7 rows (multi-tier
+    gather: realistic density ~65 candidate rows per bracket)."""
+    from sbeacon_tpu.ops.kernel import QuerySpec, encode_queries
+    from sbeacon_tpu.ops.scatter_kernel import (
+        device_time_probe,
+        run_queries_scattered,
     )
-    from sbeacon_tpu.testing import random_records
 
     rng = random.Random(3)
-    records = []
-    per = 4_000
-    for chrom in ALL_CHROMS:
-        records.extend(
-            random_records(rng, chrom=chrom, n=per, n_samples=4, spacing=200)
-        )
-    shard = build_index(records, dataset_id="wg", with_genotypes=False)
-    dindex = DeviceIndex(shard)
-    qrng = random.Random(5)
-    n_q = 4_000
+    pos = shard.cols["pos"]
+    n_q = 4000
     specs = []
     for _ in range(n_q):
-        chrom = qrng.choice(ALL_CHROMS)
-        a = qrng.randrange(1, per * 200)
+        r = rng.randrange(shard.n_rows)
+        p = int(pos[r])
         specs.append(
             QuerySpec(
-                chrom,
-                max(1, a - 2_000),
-                a + 2_000,
-                a,
-                a + 6_000,
+                shard.row_chrom(r),
+                max(1, p - 5000),
+                p + 5000,
+                1,
+                2**30,
                 alternate_bases="N",
             )
         )
     enc = encode_queries(specs)
-    res = run_queries(dindex, enc, window_cap=512, record_cap=64)
-    best, kernel, extra = _timed_best(shard, dindex, enc, res, window=512)
-    extra.pop("_pindex", None)
-    return {
-        "qps": round(n_q / best, 1),
-        "kernel": kernel,
+
+    def run():
+        return run_queries_scattered(
+            sindex, enc, window_cap=512, record_cap=64, with_rows=False
+        )
+
+    res = run()
+    best = _time_batch(run)
+    out = {
         "n_queries": n_q,
-        "index_rows": shard.n_rows,
         "hits": int(res.exists.sum()),
-        **extra,
+        "overflow": int(res.overflow.sum()),
+        "serial_qps": round(n_q / best, 1),
+        "pipelined_qps": round(_pipelined_qps(run, n_q, reps=16), 1),
     }
+    try:
+        per, gathered = device_time_probe(
+            sindex, enc, window_cap=512, iters=128
+        )
+        out["device_qps"] = round(2048 / per, 1)
+        out["gather_gb_per_s"] = round(gathered / per / 1e9, 1)
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+    return out
 
 
 def config4_multi_dataset():
-    """Multi-dataset aggregation + distinct-variant parity (own corpus)."""
+    """Multi-dataset aggregation at scale: 8 datasets x 1M rows through
+    the engine (thread scatter on one chip; the mesh path is exercised
+    by the multichip dryrun) + device/host distinct-variant parity."""
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
     from sbeacon_tpu.engine import VariantEngine
-    from sbeacon_tpu.index.columnar import build_index
     from sbeacon_tpu.ingest.pipeline import distinct_variant_count
     from sbeacon_tpu.payloads import VariantQueryPayload
-    from sbeacon_tpu.testing import random_records
+    from sbeacon_tpu.testing import synthetic_shard
 
-    rng = random.Random(17)
-    engine = VariantEngine()
+    engine = VariantEngine(
+        BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
+    )
     shards = []
     n_ds = 8
     for d in range(n_ds):
-        recs = random_records(rng, chrom="9", n=3_000, n_samples=4)
-        shard = build_index(recs, dataset_id=f"d{d}", with_genotypes=False)
-        shards.append((recs, shard))
-        engine.add_index(shard)
-
-    payload = VariantQueryPayload(
-        dataset_ids=[f"d{d}" for d in range(n_ds)],
-        reference_name="9",
-        start_min=1,
-        start_max=10**8,
-        end_min=1,
-        end_max=2**30,
-        alternate_bases="N",
-        requested_granularity="record",
-        include_datasets="HIT",
-    )
-    responses = engine.search(payload)  # warm
-    best = _time_batch(lambda: engine.search(payload), repeats=3)
-    distinct = distinct_variant_count([s for _, s in shards])
-    brute = {
-        (r.chrom, r.pos, r.ref, a)
-        for recs, _ in shards
-        for r in recs
-        for a in r.alts
-    }
+        s = synthetic_shard(
+            1_000_000,
+            seed=100 + d,
+            dataset_id=f"d{d}",
+            chroms=["9"],
+        )
+        shards.append(s)
+        engine.add_index(s)
+    # the realistic cross-dataset shape: the SAME bracket asked of all 8
+    # datasets at once (the reference's per-dataset scatter + fan-in);
+    # each dataset answers on-device, responses aggregate host-side
+    rng = random.Random(55)
+    pos0 = shards[0].cols["pos"]
+    lat = []
+    for _ in range(12):
+        p = int(pos0[rng.randrange(shards[0].n_rows)])
+        payload = VariantQueryPayload(
+            dataset_ids=[f"d{d}" for d in range(n_ds)],
+            reference_name="9",
+            start_min=max(1, p - 5000),
+            start_max=p + 5000,
+            end_min=1,
+            end_max=2**30,
+            alternate_bases="N",
+            requested_granularity="count",
+            include_datasets="HIT",
+        )
+        t0 = time.perf_counter()
+        responses = engine.search(payload)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
     out = {
         "n_datasets": n_ds,
-        "aggregate_s": round(best, 4),
+        "rows_per_dataset": 1_000_000,
+        "bracket_agg_p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
         "responses": len(responses),
-        "total_calls": int(sum(r.call_count for r in responses)),
-        "distinct_variants": distinct,
-        "distinct_parity": distinct == len(brute),
     }
-    # device-sharded distinct count (sort-unique + psum, the SURVEY §2.5
-    # duplicateVariantSearch mapping) — timed against the host path
     try:
+        t0 = time.perf_counter()
+        host = distinct_variant_count(shards)
+        t_host = time.perf_counter() - t0
         from sbeacon_tpu.parallel.distinct import distinct_count_device
         from sbeacon_tpu.parallel.mesh import make_mesh
 
         mesh = make_mesh()
-        only_shards = [s for _, s in shards]
-        d = distinct_count_device(only_shards, mesh=mesh)  # warm
+        dev = distinct_count_device(shards, mesh=mesh)  # warm+value
         t_dev = _time_batch(
-            lambda: distinct_count_device(only_shards, mesh=mesh), repeats=3
+            lambda: distinct_count_device(shards, mesh=mesh), repeats=3
         )
-        t_host = _time_batch(
-            lambda: distinct_variant_count(only_shards), repeats=3
-        )
-        out["distinct_device"] = {
-            "value": d,
-            "parity": d == distinct,
-            "device_s": round(t_dev, 4),
-            "host_s": round(t_host, 4),
+        out["distinct"] = {
+            "keys": int(sum(s.n_rows for s in shards)),
+            "value": dev,
+            "parity": dev == host,
+            "device_s": round(t_dev, 3),
+            "host_s": round(t_host, 3),
         }
     except Exception:
         traceback.print_exc(file=sys.stderr)
     return out
 
 
-def config5_sv_indel(records, shard):
-    """Structural-variant / INDEL overlap queries (variantType matching)."""
-    from sbeacon_tpu.ops.kernel import (
-        DeviceIndex,
-        QuerySpec,
-        encode_queries,
-        run_queries,
-    )
+def config5_sv_indel(shard, sindex):
+    """Structural-variant / INDEL overlap (variantType matching) at
+    2e7 rows."""
+    from sbeacon_tpu.ops.kernel import QuerySpec, encode_queries
+    from sbeacon_tpu.ops.scatter_kernel import run_queries_scattered
 
-    dindex = DeviceIndex(shard)
-    qrng = random.Random(29)
-    n_q = 2_000
-    span = int(shard.cols["pos"].max())  # keep queries inside the corpus
+    rng = random.Random(29)
+    pos = shard.cols["pos"]
+    n_q = 2000
     specs = []
     for _ in range(n_q):
-        a = qrng.randrange(1, span)
-        vt = qrng.choice(["DEL", "INS", "DUP", "DUP:TANDEM", "CNV"])
+        r = rng.randrange(shard.n_rows)
+        p = int(pos[r])
+        vt = rng.choice(["DEL", "INS", "DUP", "DUP:TANDEM", "CNV"])
         specs.append(
             QuerySpec(
-                qrng.choice(("1", "22")),
-                max(1, a - 5_000),
-                a + 5_000,
+                shard.row_chrom(r),
+                max(1, p - 5000),
+                p + 5000,
                 1,
                 2**30,
                 variant_type=vt,
@@ -572,110 +511,321 @@ def config5_sv_indel(records, shard):
             )
         )
     enc = encode_queries(specs)
-    # 10 kb spans over ~20 bp mean spacing need ~500-row windows: 1024
-    # keeps both kernels overflow-free
-    res = run_queries(dindex, enc, window_cap=1024, record_cap=64)
-    best, kernel, extra = _timed_best(shard, dindex, enc, res, window=1024)
-    extra.pop("_pindex", None)
+
+    def run():
+        return run_queries_scattered(
+            sindex, enc, window_cap=512, record_cap=64, with_rows=False
+        )
+
+    res = run()
+    best = _time_batch(run)
     return {
-        "qps": round(n_q / best, 1),
-        "kernel": kernel,
         "n_queries": n_q,
         "hits": int(res.exists.sum()),
-        **extra,
+        "overflow": int(res.overflow.sum()),
+        "serial_qps": round(n_q / best, 1),
+        "pipelined_qps": round(_pipelined_qps(run, n_q, reps=16), 1),
     }
 
 
 def config6_ingest():
-    """Ingest throughput: single-host sliced pipeline vs slice scans
-    scattered over 2 worker hosts (in-process here — the scaling story is
-    the path, reference: summariseVcf <=1000-lambda fan-out)."""
+    """Real-pipeline ingest probe at full sample width (2504 GT columns
+    through BGZF -> tabix -> slice planner -> native tokenizer -> planes)
+    + the out-of-band full-corpus manifest when present."""
     import tempfile
     from pathlib import Path
 
-    from sbeacon_tpu.config import (
-        BeaconConfig,
-        EngineConfig,
-        IngestConfig,
-        StorageConfig,
-    )
-    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.config import BeaconConfig, IngestConfig, StorageConfig
     from sbeacon_tpu.genomics.tabix import ensure_index
-    from sbeacon_tpu.genomics.vcf import write_vcf
+    from sbeacon_tpu.harness.genome1k import write_cohort_vcf
     from sbeacon_tpu.ingest.pipeline import SummarisationPipeline
-    from sbeacon_tpu.parallel.dispatch import ScanWorkerPool, WorkerServer
-    from sbeacon_tpu.testing import random_records
 
-    n_records = 30_000
+    n_records = 25_000
+    out = {}
     with tempfile.TemporaryDirectory(prefix="bench-ingest-") as td:
         root = Path(td)
-        rng = random.Random(41)
-        recs = random_records(
-            rng, chrom="2", n=n_records, n_samples=4, spacing=60
+        vcf = root / "probe.vcf.gz"
+        gen = write_cohort_vcf(
+            vcf,
+            chrom="20",
+            n_records=n_records,
+            n_samples=N_SAMPLES,
+            seed=41,
         )
-        vcf = root / "ingest.vcf.gz"
-        write_vcf(vcf, recs, sample_names=[f"S{i}" for i in range(4)])
         ensure_index(vcf)
-
-        def run(name, scan_pool):
-            config = BeaconConfig(
-                storage=StorageConfig(root=root / name),
-                ingest=IngestConfig(workers=8),
-            )
-            config.storage.ensure()
-            pipe = SummarisationPipeline(config, scan_pool=scan_pool)
-            t0 = time.perf_counter()
-            shard = pipe.summarise_vcf("bench", str(vcf))
-            dt = time.perf_counter() - t0
-            assert shard.n_rows > 0
-            return dt, shard.meta["variant_count"]
-
-        t_local, v_local = run("local", None)
-        workers = [
-            WorkerServer(
-                VariantEngine(
-                    BeaconConfig(
-                        engine=EngineConfig(
-                            microbatch=False, use_mesh=False, use_tpu=False
-                        )
-                    )
-                ),
-                open_scan=True,  # loopback-only bench workers
-            ).start_background()
-            for _ in range(2)
-        ]
-        try:
-            pool = ScanWorkerPool([w.address for w in workers])
-            t_dist, v_dist = run("dist", pool)
-        finally:
-            for w in workers:
-                w.shutdown()
-        return {
+        config = BeaconConfig(
+            storage=StorageConfig(root=root / "store"),
+            ingest=IngestConfig(workers=8),
+        )
+        config.storage.ensure()
+        pipe = SummarisationPipeline(config)
+        t0 = time.perf_counter()
+        shard = pipe.summarise_vcf("bench", str(vcf))
+        dt = time.perf_counter() - t0
+        out = {
             "n_records": n_records,
-            "single_host_rec_per_s": round(n_records / t_local, 1),
-            "two_workers_rec_per_s": round(n_records / t_dist, 1),
-            "variant_parity": v_local == v_dist,
+            "n_samples": N_SAMPLES,
+            "raw_mb": round(gen["bytes_raw"] / 1e6, 1),
+            "rec_per_s": round(n_records / dt, 1),
+            "raw_mb_per_s": round(gen["bytes_raw"] / 1e6 / dt, 1),
+            "rows": shard.n_rows,
         }
+    manifest = Path(__file__).parent / "INGEST_r03.json"
+    if manifest.exists():
+        try:
+            totals = json.loads(manifest.read_text()).get("totals")
+            if totals:
+                out["full_corpus_manifest"] = totals
+        except Exception:
+            pass
+    return out
+
+
+def config7_selected_samples(shard, sindex):
+    """Selected-samples queries at full 2504-sample plane width (the
+    restricted-counting leaf) + vectorised host materialisation on
+    record queries returning >=1e4 rows (VERDICT r2 #3/#7)."""
+    from sbeacon_tpu.engine import (
+        VariantEngine,
+        host_match_rows,
+        materialize_response,
+        materialize_response_loop,
+    )
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.ops.kernel import QuerySpec
+    from sbeacon_tpu.payloads import VariantQueryPayload
+
+    engine = VariantEngine(
+        BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
+    )
+    engine.add_prebuilt_index(shard, sindex)
+    rng = random.Random(31)
+    names = shard.meta["sample_names"]
+    selected = [names[rng.randrange(len(names))] for _ in range(100)]
+    pos = shard.cols["pos"]
+    lat = []
+    for _ in range(15):
+        r = rng.randrange(shard.n_rows)
+        payload = VariantQueryPayload(
+            dataset_ids=["bench1kg"],
+            reference_name=shard.row_chrom(r),
+            start_min=max(1, int(pos[r]) - 2000),
+            start_max=int(pos[r]) + 2000,
+            end_min=1,
+            end_max=2**30,
+            alternate_bases="N",
+            requested_granularity="record",
+            include_datasets="HIT",
+            include_samples=True,
+            selected_samples_only=True,
+            sample_names={"bench1kg": selected},
+        )
+        t0 = time.perf_counter()
+        engine.search(payload)
+        lat.append(time.perf_counter() - t0)
+    lat.sort()
+    out = {
+        "n_selected": len(selected),
+        "plane_width_words": int(shard.gt_bits.shape[1]),
+        "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+    }
+
+    # wide record query -> 1e4+ matched rows, host materialisation path
+    # (window chosen inside ONE chromosome segment: positions reset per
+    # chromosome, so a row range crossing a boundary would be empty)
+    import numpy as np
+
+    seg_sizes = np.diff(shard.chrom_offsets)
+    code = int(np.argmax(seg_sizes))  # biggest chromosome segment
+    a = int(shard.chrom_offsets[code])
+    r = a + rng.randrange(max(1, int(seg_sizes[code]) - 15_000))
+    r_end = min(r + 12_000, a + int(seg_sizes[code]) - 1)
+    spec = QuerySpec(
+        shard.row_chrom(r),
+        int(pos[r]),
+        int(pos[r_end]),
+        1,
+        2**30,
+        alternate_bases="N",
+    )
+    rows = host_match_rows(shard, spec)
+    payload = VariantQueryPayload(
+        dataset_ids=["bench1kg"],
+        reference_name=spec.chrom,
+        start_min=spec.start_min,
+        start_max=spec.start_max,
+        end_min=1,
+        end_max=2**30,
+        requested_granularity="record",
+        include_datasets="HIT",
+        include_samples=True,
+    )
+    kw = dict(chrom_label=spec.chrom, dataset_id="bench1kg")
+    t_vec = _time_batch(
+        lambda: materialize_response(shard, rows, payload, **kw), repeats=3
+    )
+    t_loop = _time_batch(
+        lambda: materialize_response_loop(shard, rows, payload, **kw),
+        repeats=1,
+    )
+    a = materialize_response(shard, rows, payload, **kw)
+    b = materialize_response_loop(shard, rows, payload, **kw)
+    out["materialize_1e4_rows"] = {
+        "rows": int(len(rows)),
+        "vectorized_ms": round(t_vec * 1e3, 2),
+        "loop_ms": round(t_loop * 1e3, 2),
+        "speedup": round(t_loop / t_vec, 1) if t_vec else None,
+        "parity": a == b,
+    }
+    return out
+
+
+def config8_skew():
+    """Skew-realistic distributions (VERDICT r2 #8): clustered/hotspot
+    positions vs uniform, device-probed on same-size corpora."""
+    from sbeacon_tpu.ops.kernel import encode_queries
+    from sbeacon_tpu.ops.scatter_kernel import (
+        ScatterDeviceIndex,
+        device_time_probe,
+        run_queries_scattered,
+    )
+    from sbeacon_tpu.testing import synthetic_shard
+
+    out = {}
+    for model in ("uniform", "clustered"):
+        shard = synthetic_shard(
+            5_000_000,
+            seed=77,
+            dataset_id=f"skew-{model}",
+            position_model=model,
+        )
+        sindex = ScatterDeviceIndex(shard)
+        specs = _point_specs(shard, 4000, seed=9)
+        enc = encode_queries(specs)
+        res = run_queries_scattered(
+            sindex, enc, window_cap=512, record_cap=64, with_rows=False
+        )
+        entry = {
+            "rows": shard.n_rows,
+            "hits": int(res.exists.sum()),
+            "overflow": int(res.overflow.sum()),
+        }
+        try:
+            per, gathered = device_time_probe(
+                sindex, enc, window_cap=128, iters=256
+            )
+            entry["device_qps"] = round(2048 / per, 1)
+            entry["gather_gb_per_s"] = round(gathered / per / 1e9, 1)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
+        out[model] = entry
+    return out
+
+
+def config9_soak(shard, sindex):
+    """Concurrent HTTP soak against the 2e7-row corpus on the real
+    server + TPU engine: p50/p95/p99 + micro-batcher occupancy."""
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.api.server import start_background
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig, StorageConfig
+    from sbeacon_tpu.harness.latency import run_concurrent_soak
+    import tempfile
+    from pathlib import Path
+
+    with tempfile.TemporaryDirectory(prefix="bench-soak-") as td:
+        cfg = BeaconConfig(
+            storage=StorageConfig(root=Path(td)),
+            engine=EngineConfig(
+                use_mesh=False, microbatch=True, microbatch_wait_ms=10.0
+            ),
+        )
+        cfg.storage.ensure()
+        app = BeaconApp(cfg)
+        app.engine.add_prebuilt_index(shard, sindex)
+        app.store.upsert(
+            "datasets",
+            [
+                {
+                    "id": "bench1kg",
+                    "name": "bench",
+                    "_assemblyId": "GRCh38",
+                    "_vcfLocations": ["synthetic://bench1kg"],
+                }
+            ],
+        )
+        server, _t = start_background(app)
+        base = f"http://127.0.0.1:{server.server_address[1]}"
+        rng = random.Random(13)
+        pos = shard.cols["pos"]
+        queries = []
+        for k in range(16 * 25):
+            r = rng.randrange(shard.n_rows)
+            queries.append(
+                {
+                    "query": {
+                        "requestedGranularity": "boolean",
+                        "requestParameters": {
+                            "assemblyId": "GRCh38",
+                            "referenceName": shard.row_chrom(r),
+                            "start": [int(pos[r]) - 1],
+                            "end": [int(pos[r]) + 1 + (k % 5)],
+                            "alternateBases": "N",
+                        },
+                    }
+                }
+            )
+        out = run_concurrent_soak(
+            base,
+            queries=queries,
+            n_clients=16,
+            requests_per_client=25,
+            engine=app.engine,
+        )
+        server.shutdown()
+        # histograms serialise poorly at full width; keep the summary
+        if "batcher" in out:
+            hist = out["batcher"].pop("histogram", {})
+            out["batcher"]["max_batch"] = max(hist) if hist else 0
+        return out
 
 
 def main() -> None:
-    records, shard = build_corpus()
+    t_all = time.perf_counter()
+    shard, build_s = build_corpus()
+    from sbeacon_tpu.ops.scatter_kernel import ScatterDeviceIndex
 
-    qps, d2 = config2_point_queries(shard)
+    t0 = time.perf_counter()
+    sindex = ScatterDeviceIndex(shard)
+    upload_s = time.perf_counter() - t0
+
+    qps, d2 = config2_point_queries(shard, sindex)
     detail = {
-        "n_queries": N_QUERIES,
         "index_rows": shard.n_rows,
+        "n_samples": shard.meta["sample_count"],
+        "chroms": 22,
+        "corpus_build_s": round(build_s, 1),
+        "index_upload_s": round(upload_s, 1),
+        "index_hbm_gb": round(sindex.nbytes() / 1e9, 2),
+        "roofline": {
+            "chip": "TPU v5e (v5 lite), 1 chip",
+            "hbm_peak_gb_per_s": V5E_HBM_PEAK_GBPS,
+        },
+        "n_queries": N_QUERIES,
         **d2,
-        "config1_single_snv": config1_single_snv(records, shard),
-        "config3_bracket_chr1_22": config3_bracket_ranges(),
+        "config1_single_snv": config1_single_snv(shard, sindex),
+        "config3_bracket_chr1_22": config3_brackets(shard, sindex),
         "config4_multi_dataset": config4_multi_dataset(),
-        "config5_sv_indel": config5_sv_indel(records, shard),
+        "config5_sv_indel": config5_sv_indel(shard, sindex),
         "config6_ingest": config6_ingest(),
+        "config7_selected_samples": config7_selected_samples(shard, sindex),
+        "config8_skew": config8_skew(),
+        "config9_soak": config9_soak(shard, sindex),
     }
+    detail["bench_wall_s"] = round(time.perf_counter() - t_all, 1)
     print(
         json.dumps(
             {
-                "metric": "batched_point_queries_single_chip",
+                "metric": "batched_point_queries_single_chip_20M_rows",
                 "value": round(qps, 1),
                 "unit": "queries/sec",
                 "vs_baseline": round(qps / BASELINE_QPS, 2),

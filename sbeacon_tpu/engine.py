@@ -595,6 +595,15 @@ class VariantEngine:
             self._mesh_dirty = True
             self._indexes[key] = (shard, dindex)
 
+    def add_prebuilt_index(self, shard: VariantIndexShard, dindex) -> None:
+        """Register a shard with an ALREADY-BUILT device index (benchmarks
+        and bulk loaders that construct/upload the index out of band) —
+        keeps the private ``_indexes`` key/locking contract in one place."""
+        key = (shard.meta.get("dataset_id", ""), shard.meta.get("vcf_location", ""))
+        with self._mesh_lock:
+            self._mesh_dirty = True
+            self._indexes[key] = (shard, dindex)
+
     def close(self) -> None:
         """Release the scatter pool (same contract as
         DistributedEngine.close)."""

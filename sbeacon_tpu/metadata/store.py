@@ -49,10 +49,20 @@ class MetadataStore:
         *,
         ontology: OntologyStore | None = None,
     ):
-        if path != ":memory:":
+        self._path = str(path)
+        if self._path != ":memory:":
             Path(path).parent.mkdir(parents=True, exist_ok=True)
-        self.conn = sqlite3.connect(str(path), check_same_thread=False)
+        self.conn = sqlite3.connect(self._path, check_same_thread=False)
         self._lock = threading.Lock()
+        self._tlocal = threading.local()
+        self._read_conns: list = []
+        if self._path != ":memory:":
+            # WAL: writers never block readers, so per-thread read
+            # connections can serve concurrently while upserts/rebuilds
+            # proceed — one slow analytic count must not head-of-line
+            # block the 0.13 ms boolean path (code-review r3)
+            self.conn.execute("PRAGMA journal_mode=WAL")
+            self.conn.execute("PRAGMA busy_timeout=10000")
         self.ontology = ontology
         self._create_tables()
 
@@ -86,6 +96,29 @@ class MetadataStore:
             """
         )
         self.conn.commit()
+
+    def _read(self, sql: str, params=()):  # noqa: D401
+        """Thread-safe read.
+
+        File-backed stores: one sqlite connection PER READER THREAD
+        (WAL mode), so reads run truly concurrently and never wait on
+        the write lock. In-memory stores (tests): per-thread
+        connections would each be a distinct empty database, so reads
+        share the write connection under the lock — the lock is also
+        what prevents the InterfaceError ('bad parameter or other API
+        misuse') that concurrent cursor use on a shared connection
+        raises under load (first seen as soak-test HTTP 500s)."""
+        if self._path == ":memory:":
+            with self._lock:
+                return self.conn.execute(sql, params).fetchall()
+        conn = getattr(self._tlocal, "conn", None)
+        if conn is None:
+            conn = sqlite3.connect(self._path)
+            conn.execute("PRAGMA busy_timeout=10000")
+            self._tlocal.conn = conn
+            with self._lock:
+                self._read_conns.append(conn)
+        return conn.execute(sql, params).fetchall()
 
     # -- writes -------------------------------------------------------------
 
@@ -225,7 +258,7 @@ class MetadataStore:
             f"SELECT _doc FROM {kind} {where} "
             f"ORDER BY id LIMIT ? OFFSET ?"
         )
-        rows = self.conn.execute(sql, [*params, limit, skip]).fetchall()
+        rows = self._read(sql, [*params, limit, skip])
         return [json.loads(r[0]) for r in rows]
 
     def count(
@@ -245,7 +278,7 @@ class MetadataStore:
             )
             params = params + list(extra_params or [])
         sql = f"SELECT COUNT(*) FROM {kind} {where}"
-        return int(self.conn.execute(sql, params).fetchone()[0])
+        return int(self._read(sql, params)[0][0])
 
     def exists(
         self,
@@ -274,31 +307,31 @@ class MetadataStore:
             outer_params = outer_params + list(extra_params or [])
         if not subs:
             where = f"WHERE {' AND '.join(outer)}" if outer else ""
-            row = self.conn.execute(
+            rows = self._read(
                 f"SELECT 1 FROM {kind} {where} LIMIT 1", outer_params
-            ).fetchone()
-            return row is not None
+            )
+            return bool(rows)
         comp = " INTERSECT ".join(subs)
         # unqualified outer-predicate columns resolve to ``e`` inside the
         # probe (the streamed row ``t`` exposes only the relation id)
         preds = "".join(f" AND {p}" for p in outer)
-        row = self.conn.execute(
+        rows = self._read(
             f"SELECT 1 FROM ({comp}) t WHERE EXISTS("
             f"SELECT 1 FROM {kind} e WHERE e.id = t.{my_rel}{preds}) "
             f"LIMIT 1",
             list(join_params) + list(outer_params),
-        ).fetchone()
-        return row is not None
+        )
+        return bool(rows)
 
     def get_by_id(self, kind: str, entity_id: str) -> dict | None:
-        row = self.conn.execute(
+        rows = self._read(
             f"SELECT _doc FROM {kind} WHERE id = ?", (entity_id,)
-        ).fetchone()
-        return json.loads(row[0]) if row else None
+        )
+        return json.loads(rows[0][0]) if rows else None
 
     def query(self, sql: str, params: list | tuple = ()) -> list[tuple]:
         """Raw parameterised SQL (the run_custom_query escape hatch)."""
-        return self.conn.execute(sql, params).fetchall()
+        return self._read(sql, params)
 
     # -- filtering terms ----------------------------------------------------
 
@@ -312,11 +345,11 @@ class MetadataStore:
         if kinds:
             where = f"WHERE kind IN ({', '.join('?' for _ in kinds)})"
             params = list(kinds)
-        rows = self.conn.execute(
+        rows = self._read(
             f"SELECT DISTINCT term, label, type FROM terms {where} "
             f"ORDER BY term ASC LIMIT ? OFFSET ?",
             [*params, limit, skip],
-        ).fetchall()
+        )
         return [
             {"id": t, "label": lb, "type": ty} for t, lb, ty in rows
         ]
@@ -351,11 +384,11 @@ class MetadataStore:
     ) -> dict[str, list[str]]:
         """dataset_id -> vcf sample names via the analyses table
         (reference route_individuals_id_g_variants.py:23-34 Athena join)."""
-        rows = self.conn.execute(
+        rows = self._read(
             f"SELECT _datasetid, _vcfsampleid FROM analyses "
             f"WHERE {column} = ? AND _vcfsampleid != ''",
             (entity_id,),
-        ).fetchall()
+        )
         out: dict[str, list[str]] = {}
         for ds, sample in rows:
             out.setdefault(ds, []).append(sample)
@@ -397,11 +430,11 @@ class MetadataStore:
                 f"AND TI.kind = '{child}' WHERE E.{fk} = ?"
             )
             params.append(entity_id)
-        rows = self.conn.execute(
+        rows = self._read(
             "SELECT DISTINCT term, label, type FROM terms WHERE term IN "
             f"({' UNION '.join(union)}) ORDER BY term LIMIT ? OFFSET ?",
             [*params, limit, skip],
-        ).fetchall()
+        )
         return [{"id": t, "label": lb, "type": ty} for t, lb, ty in rows]
 
     def entities_for_samples(
@@ -423,14 +456,21 @@ class MetadataStore:
         if not sample_names:
             return []
         ph = ", ".join("?" for _ in sample_names)
-        rows = self.conn.execute(
+        rows = self._read(
             f"SELECT DISTINCT E._doc FROM {kind} E "
             f"JOIN analyses A ON A.{join_col} = E.id "
             f"WHERE A._datasetid = ? AND A._vcfsampleid IN ({ph}) "
             f"ORDER BY E.id LIMIT ? OFFSET ?",
             [dataset_id, *sample_names, limit, skip],
-        ).fetchall()
+        )
         return [json.loads(r[0]) for r in rows]
 
     def close(self) -> None:
+        with self._lock:
+            for c in self._read_conns:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            self._read_conns.clear()
         self.conn.close()
