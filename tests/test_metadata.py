@@ -231,3 +231,40 @@ def test_numeric_filters_compare_numerically(store):
     # numeric '!' means !=
     f = [{"id": "cohortSize", "operator": "!", "value": 90}]
     assert "c3" not in [d["id"] for d in store.fetch("cohorts", f)]
+
+
+def test_wal_reads_see_committed_writes_across_threads(tmp_path):
+    """File-backed stores use per-thread WAL read connections; a write
+    committed on the main connection must be immediately visible to a
+    fresh reader thread, and concurrent readers must not interfere."""
+    import threading
+
+    from sbeacon_tpu.metadata import MetadataStore
+
+    store = MetadataStore(tmp_path / "m.sqlite")
+    store.upsert("datasets", [{"id": "d1", "name": "first"}])
+    seen = {}
+
+    def reader(k):
+        seen[k] = store.get_by_id("datasets", "d1")
+
+    threads = [threading.Thread(target=reader, args=(k,)) for k in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert all(v and v["name"] == "first" for v in seen.values())
+    # a later write is visible to the SAME reader threads' connections
+    store.upsert("datasets", [{"id": "d1", "name": "second"}])
+    out = {}
+
+    def reader2(k):
+        out[k] = store.get_by_id("datasets", "d1")["name"]
+
+    threads = [threading.Thread(target=reader2, args=(k,)) for k in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert set(out.values()) == {"second"}
+    store.close()
