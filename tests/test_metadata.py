@@ -268,3 +268,49 @@ def test_wal_reads_see_committed_writes_across_threads(tmp_path):
         t.join()
     assert set(out.values()) == {"second"}
     store.close()
+
+
+def test_dense_fetch_walk_matches_generic_shape(tmp_path):
+    """The dense single-term fetch fast path (correlated-EXISTS walk)
+    must return exactly the generic id-IN-subquery page: same rows,
+    order, skip/limit behaviour."""
+    import random
+
+    from sbeacon_tpu.harness.scale import (
+        populate_metadata_bulk,
+        seed_phenotype_closure,
+    )
+    from sbeacon_tpu.metadata import MetadataStore, OntologyStore
+
+    ont = OntologyStore()
+    store = MetadataStore(tmp_path / "m.sqlite", ontology=ont)
+    seed_phenotype_closure(ont)
+    populate_metadata_bulk(store, n_datasets=4, individuals_per=60)
+    store.rebuild_indexes()
+
+    dense = [{"id": "NCIT:C16576"}]  # ~half the individuals
+    ontology_f = [{"id": "HP:0000118", "includeDescendantTerms": True}]
+    for filters in (dense, ontology_f):
+        assert store._dense_single_term(filters, "individuals") is not None
+        fast = store.fetch("individuals", filters, skip=5, limit=17)
+        # force the generic shape by bypassing the heuristic
+        where, params = store._compile(filters, "individuals")
+        rows = store._read(
+            f"SELECT _doc FROM individuals {where} "
+            f"ORDER BY id LIMIT ? OFFSET ?",
+            [*params, 17, 5],
+        )
+        import json as _json
+
+        want = [_json.loads(r[0]) for r in rows]
+        assert fast == want
+    # sparse filters keep the generic path
+    assert store._dense_single_term(
+        [{"id": "HP:9999999", "includeDescendantTerms": False}], "individuals"
+    ) is None
+    # own-column and multi-filter shapes are never diverted
+    assert store._dense_single_term(
+        [{"id": "karyotypicSex", "operator": "=", "value": "XX"}],
+        "individuals",
+    ) is None
+    assert store._dense_single_term(dense + ontology_f, "individuals") is None
