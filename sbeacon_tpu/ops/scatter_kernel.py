@@ -75,13 +75,14 @@ from .kernel import (
     _PAD_FILLS,
     encode_queries,
 )
-from .pallas_kernel import (
+from .query_pack import (
     PM_CNV,
     PM_DUPT,
     PM_INS,
     _rows_from_masks,
     _window_bounds,
     pack_q8,
+    stage_symbolic_flags,
 )
 
 # packed hot-matrix rows
@@ -149,8 +150,6 @@ class ScatterDeviceIndex:
             np.minimum(c["ref_len"].astype(np.int64), _REF_LEN_CLAMP) << 16
         )
         fill(P_LENS, lens.astype(np.int64).astype(np.int32), 0)
-        from .pallas_kernel import stage_symbolic_flags
-
         flags = stage_symbolic_flags(c["flags"], c["alt_prefix"])
         k1 = np.clip(c["ref_repeat_k"].astype(np.int64) + 1, 0, 127)
         flags |= k1 << 19
@@ -183,18 +182,31 @@ class ScatterDeviceIndex:
         return int(self.tiles.size) * 4
 
 
-@partial(jax.jit, static_argnames=("T", "CAP", "nslots"))
-def _scatter_batch(tiles, tile_ids, qarr, *, T, CAP, nslots):
+@partial(
+    jax.jit, static_argnames=("T", "CAP", "nslots", "C", "exact_only")
+)
+def _scatter_batch(
+    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False
+):
     """One fixed-size device batch: C-tile gather + vectorised predicates.
 
     ``tile_ids``: [nslots] int32 (padding slots point at tile 0 with
     lo=hi=0 so nothing matches). ``qarr``: [nslots, 8] packed queries
-    (pallas_kernel.pack_q8 encoding — shared with the grouped kernel).
-    ``C = CAP//T + 1`` consecutive tiles cover any window of width
-    <= CAP whose start lies anywhere inside the first tile. Returns
-    (agg [nslots, 8] int32, masks [nslots, C*T/16] int32).
+    (query_pack.pack_q8 encoding — shared with the grouped kernel).
+    By default ``C = CAP//T + 1`` consecutive tiles cover any window of
+    width <= CAP whose start lies anywhere inside the first tile. The
+    single-tile fast tier passes ``C=1`` explicitly (half the HBM
+    gather of the C=2 tier): the caller guarantees every query's
+    window lies inside ONE tile (``lo//T == (hi-1)//T``), so one tile
+    covers it. ``exact_only=True`` is a static specialisation for
+    batches whose queries are ALL MODE_EXACT (the dominant point-lookup
+    shape): the symbolic variant-type predicate chain and its flag/k
+    extraction drop out of the compiled program (~1.35x on v5e —
+    the C=1 batch is no longer purely gather-bound, so VPU work
+    matters). Returns (agg [nslots, 8] int32,
+    masks [nslots, C*T/16] int32).
     """
-    from .pallas_kernel import (
+    from .query_pack import (
         Q_ALT_HASH,
         Q_END_MAX,
         Q_END_MIN,
@@ -205,7 +217,8 @@ def _scatter_batch(tiles, tile_ids, qarr, *, T, CAP, nslots):
         Q_REF_HASH,
     )
 
-    C = CAP // T + 1
+    if C is None:
+        C = CAP // T + 1
     span = C * T
     gat = tiles[
         tile_ids[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
@@ -248,53 +261,63 @@ def _scatter_batch(tiles, tile_ids, qarr, *, T, CAP, nslots):
 
     flags = row(P_FLAGS)
     f = lambda bit: b2i((flags & bit) != 0)
-    sym = f(FLAG.SYMBOLIC)
-    nsym = 1 - sym
-    k = ((flags >> 19) & 0x7F) - 1
-
-    del_ok = (sym & (f(FLAG.DEL_PREFIX) | f(FLAG.CN0))) | (
-        nsym & b2i(alt_len < ref_len)
-    )
-    ins_ok = (sym & f(PM_INS)) | (nsym & b2i(alt_len > ref_len))
-    dup_ok = (
-        sym
-        & (
-            f(FLAG.DUP_PREFIX)
-            | (f(FLAG.CN_PREFIX) & (1 - f(FLAG.CN0)) & (1 - f(FLAG.CN1)))
-        )
-    ) | (nsym & b2i(k >= 2))
-    dupt_ok = (sym & (f(PM_DUPT) | f(FLAG.CN2))) | (nsym & b2i(k == 2))
-    cnv_ok = (
-        sym
-        & (f(PM_CNV) | f(FLAG.CN_PREFIX) | f(FLAG.DEL_PREFIX) | f(FLAG.DUP_PREFIX))
-    ) | (nsym & (f(FLAG.DOT) | b2i(k >= 1)))
-    other_ok = jnp.zeros_like(valid)
-    type_ok = jnp.where(
-        vt == VT_DEL,
-        del_ok,
-        jnp.where(
-            vt == VT_INS,
-            ins_ok,
-            jnp.where(
-                vt == VT_DUP,
-                dup_ok,
-                jnp.where(
-                    vt == VT_DUP_TANDEM,
-                    dupt_ok,
-                    jnp.where(vt == VT_CNV, cnv_ok, other_ok),
-                ),
-            ),
-        ),
-    )
     exact_ok = b2i(row(P_ALT_HASH) == q(Q_ALT_HASH)) & b2i(
         alt_len == alt_len_q
     )
-    anyb_ok = f(FLAG.SINGLE_BASE)
-    alt_ok = jnp.where(
-        mode == MODE_EXACT,
-        exact_ok,
-        jnp.where(mode == MODE_ANY_BASE, anyb_ok, type_ok),
-    )
+    if exact_only:
+        # static specialisation: every query in the batch is MODE_EXACT
+        # — the whole symbolic-type chain below is dead code
+        alt_ok = exact_ok
+    else:
+        sym = f(FLAG.SYMBOLIC)
+        nsym = 1 - sym
+        k = ((flags >> 19) & 0x7F) - 1
+
+        del_ok = (sym & (f(FLAG.DEL_PREFIX) | f(FLAG.CN0))) | (
+            nsym & b2i(alt_len < ref_len)
+        )
+        ins_ok = (sym & f(PM_INS)) | (nsym & b2i(alt_len > ref_len))
+        dup_ok = (
+            sym
+            & (
+                f(FLAG.DUP_PREFIX)
+                | (f(FLAG.CN_PREFIX) & (1 - f(FLAG.CN0)) & (1 - f(FLAG.CN1)))
+            )
+        ) | (nsym & b2i(k >= 2))
+        dupt_ok = (sym & (f(PM_DUPT) | f(FLAG.CN2))) | (nsym & b2i(k == 2))
+        cnv_ok = (
+            sym
+            & (
+                f(PM_CNV)
+                | f(FLAG.CN_PREFIX)
+                | f(FLAG.DEL_PREFIX)
+                | f(FLAG.DUP_PREFIX)
+            )
+        ) | (nsym & (f(FLAG.DOT) | b2i(k >= 1)))
+        other_ok = jnp.zeros_like(valid)
+        type_ok = jnp.where(
+            vt == VT_DEL,
+            del_ok,
+            jnp.where(
+                vt == VT_INS,
+                ins_ok,
+                jnp.where(
+                    vt == VT_DUP,
+                    dup_ok,
+                    jnp.where(
+                        vt == VT_DUP_TANDEM,
+                        dupt_ok,
+                        jnp.where(vt == VT_CNV, cnv_ok, other_ok),
+                    ),
+                ),
+            ),
+        )
+        anyb_ok = f(FLAG.SINGLE_BASE)
+        alt_ok = jnp.where(
+            mode == MODE_EXACT,
+            exact_ok,
+            jnp.where(mode == MODE_ANY_BASE, anyb_ok, type_ok),
+        )
 
     m_i = valid & end_ok & ref_ok & len_ok & alt_ok  # [B, 2T] 0/1
 
@@ -374,9 +397,10 @@ def _tier_caps(sindex: ScatterDeviceIndex, window_cap: int) -> list[int]:
     return caps
 
 
-def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks):
+def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks, C=None, exact_only=False):
     """Device execution for one tier, chunk-padded; returns host arrays
-    (agg[, masks]) trimmed to len(tile_ids)."""
+    (agg[, masks]) trimmed to len(tile_ids). ``C=1`` is the single-tile
+    fast tier (caller guarantees each window sits inside one tile)."""
     b = len(tile_ids)
     nslots = CHUNK_SMALL if b <= CHUNK_SMALL else CHUNK
     pad = (-b) % nslots
@@ -393,6 +417,8 @@ def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks):
             T=T,
             CAP=cap,
             nslots=nslots,
+            C=C,
+            exact_only=exact_only,
         )
     else:
         agg, masks = _scatter_many(
@@ -402,6 +428,8 @@ def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks):
             T=T,
             CAP=cap,
             nslots=nslots,
+            C=C,
+            exact_only=exact_only,
         )
         agg = agg.reshape(nc * nslots, 8)
         masks = masks.reshape(nc * nslots, -1)
@@ -451,6 +479,13 @@ def run_queries_scattered(
     # the top tier so their aggregate slots still exist
     tier_of = np.searchsorted(np.asarray(caps), width, side="left")
     tier_of = np.minimum(tier_of, len(caps) - 1)
+    # single-tile fast tier (tier -1): a window wholly inside one tile
+    # needs a C=1 gather — half the HBM bytes of the base C=2 tier. At
+    # point-query widths (a handful of rows) ~97% of queries qualify;
+    # only tile-straddlers pay the 2-tile gather. Empty windows
+    # (hi <= lo) qualify trivially.
+    single = (np.maximum(hi, lo + 1) - 1) // T <= tile_ids_all
+    tier_of = np.where(single & (tier_of == 0), -1, tier_of)
 
     agg = np.zeros((b, 8), np.int32)
     rows = (
@@ -458,21 +493,30 @@ def run_queries_scattered(
         if with_rows
         else np.zeros((b, 0), np.int32)
     )
-    for ti, cap in enumerate(caps):
-        sel = np.flatnonzero(tier_of == ti)
-        if not len(sel):
-            continue
-        a, masks = _run_tier(
-            sindex,
-            tile_ids_all[sel],
-            q8[sel],
-            cap=cap,
-            fetch_masks=with_rows,
-        )
-        agg[sel] = a
-        if with_rows:
-            base_rows = tile_ids_all[sel].astype(np.int64) * T
-            rows[sel] = _rows_from_masks(masks, base_rows, record_cap)
+    # each tier further splits exact-mode queries from the rest so the
+    # dominant point-lookup shape compiles to the specialised
+    # exact-only program (the symbolic-type chain dropped); a tier
+    # whose queries are all one kind costs no extra dispatch
+    is_exact = enc["alt_mode"] == MODE_EXACT
+    for ti, cap in [(-1, T)] + list(enumerate(caps)):
+        in_tier = tier_of == ti
+        for exact in (True, False):
+            sel = np.flatnonzero(in_tier & (is_exact == exact))
+            if not len(sel):
+                continue
+            a, masks = _run_tier(
+                sindex,
+                tile_ids_all[sel],
+                q8[sel],
+                cap=cap,
+                fetch_masks=with_rows,
+                C=1 if ti == -1 else None,
+                exact_only=exact,
+            )
+            agg[sel] = a
+            if with_rows:
+                base_rows = tile_ids_all[sel].astype(np.int64) * T
+                rows[sel] = _rows_from_masks(masks, base_rows, record_cap)
 
     # overflow honours the CALLER's window_cap (the engine's on-device
     # promise), not the tile-rounded top tier — answers for widths in
@@ -494,20 +538,31 @@ def run_queries_scattered(
     )
 
 
-@partial(jax.jit, static_argnames=("T", "CAP", "nslots"))
-def _scatter_many(tiles, tile_ids, qarr, *, T, CAP, nslots):
+@partial(
+    jax.jit, static_argnames=("T", "CAP", "nslots", "C", "exact_only")
+)
+def _scatter_many(
+    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False
+):
     """lax.map over fixed-size chunks (one compiled program regardless
     of logical batch size, same trick as the grouped kernel)."""
 
     def run(args):
         tids, qs = args
-        return _scatter_batch(tiles, tids, qs, T=T, CAP=CAP, nslots=nslots)
+        return _scatter_batch(
+            tiles, tids, qs, T=T, CAP=CAP, nslots=nslots, C=C,
+            exact_only=exact_only,
+        )
 
     return jax.lax.map(run, (tile_ids, qarr))
 
 
-@partial(jax.jit, static_argnames=("T", "CAP", "nslots", "k"))
-def _probe_rep(tiles, tile_ids, qarr, *, T, CAP, nslots, k):
+@partial(
+    jax.jit, static_argnames=("T", "CAP", "nslots", "k", "C", "exact_only")
+)
+def _probe_rep(
+    tiles, tile_ids, qarr, *, T, CAP, nslots, k, C=None, exact_only=False
+):
     """k serialized batch executions inside ONE dispatch.
 
     The carry must be a REAL data dependency: the grouped-kernel probe's
@@ -522,7 +577,8 @@ def _probe_rep(tiles, tile_ids, qarr, *, T, CAP, nslots, k):
 
     def body(carry, _):
         agg, _masks = _scatter_batch(
-            tiles, carry, qarr, T=T, CAP=CAP, nslots=nslots
+            tiles, carry, qarr, T=T, CAP=CAP, nslots=nslots, C=C,
+            exact_only=exact_only,
         )
         return (carry + agg[0, 1]) % n_tiles, agg[0, 1]
 
@@ -530,37 +586,15 @@ def _probe_rep(tiles, tile_ids, qarr, *, T, CAP, nslots, k):
     return jnp.sum(outs)
 
 
-def device_time_probe(
-    sindex: ScatterDeviceIndex,
-    queries,
-    *,
-    window_cap: int | None = None,
-    iters: int = 128,
+def _probe_one_tier(
+    sindex, tile_ids, q8, *, cap, C, iters, exact_only=False
 ) -> tuple[float, int]:
-    """(seconds per batch on-device, HBM bytes gathered per batch) by
-    two-chain differencing through ``device_get`` — RTT, dispatch and
-    transfer cancel exactly (see pallas_kernel.device_time_probe for the
-    methodology; this backend's block_until_ready returns early)."""
+    """Chain-differenced (seconds per batch, bytes gathered per batch)
+    for ONE compiled tier batch (tile_ids/q8 already nslots-sized)."""
     import time as _time
 
-    enc = encode_queries(queries) if isinstance(queries, list) else queries
     T = sindex.tile
-    # round UP like _tier_caps does for serving, so the probe times the
-    # same gather width serving actually performs
-    cap = min(-(-(window_cap or T) // T) * T, (sindex.MAX_C - 1) * T)
-    lo, hi = _window_bounds(sindex, enc)
-    q8, _nh = pack_q8(enc, lo, hi)
-    tile_ids = (lo // T).astype(np.int32)
-    b = len(tile_ids)
-    nslots = CHUNK_SMALL if b <= CHUNK_SMALL else CHUNK
-    pad = (-b) % nslots
-    if pad:
-        tile_ids = np.concatenate([tile_ids, np.zeros(pad, np.int32)])
-        q8 = np.concatenate([q8, np.zeros((pad, 8), np.int32)])
-    # the probe times exactly one device chunk (the compiled unit); a
-    # multi-chunk batch is truncated — report per-slot time x nslots
-    tile_ids = tile_ids[:nslots]
-    q8 = q8[:nslots]
+    nslots = len(tile_ids)
     td = jnp.asarray(tile_ids)
     qd = jnp.asarray(q8)
     k1 = 8
@@ -573,7 +607,15 @@ def device_time_probe(
             np.asarray(
                 jax.device_get(
                     _probe_rep(
-                        sindex.tiles, td, qd, T=T, CAP=cap, nslots=nslots, k=k
+                        sindex.tiles,
+                        td,
+                        qd,
+                        T=T,
+                        CAP=cap,
+                        nslots=nslots,
+                        k=k,
+                        C=C,
+                        exact_only=exact_only,
                     )
                 )
             )
@@ -588,6 +630,67 @@ def device_time_probe(
             f"device_time_probe: unmeasurable — {iters}-batch signal "
             f"below timing jitter ({delta * 1e3:.3f} ms); raise iters"
         )
-    per = delta / iters
-    gathered = nslots * N_PACKED * (cap // T + 1) * T * 4
-    return per, gathered
+    n_gather_tiles = C if C is not None else cap // T + 1
+    gathered = nslots * N_PACKED * n_gather_tiles * T * 4
+    return delta / iters, gathered
+
+
+def device_time_probe(
+    sindex: ScatterDeviceIndex,
+    queries,
+    *,
+    window_cap: int | None = None,
+    iters: int = 128,
+) -> tuple[float, int]:
+    """(seconds per batch on-device, HBM bytes gathered per batch) by
+    two-chain differencing through ``device_get`` — RTT, dispatch and
+    transfer cancel exactly (see pallas_kernel.device_time_probe for the
+    methodology; this backend's block_until_ready returns early).
+
+    Times the SAME tier mix serving runs: queries whose window sits in
+    one tile are timed in the C=1 fast tier (split exact/non-exact like
+    serving), the rest in the windowed C-tile tier, and the reported
+    per-batch figure is the share-weighted combination (each tier
+    probed as a full batch of its own queries, cycled to batch size)."""
+    enc = encode_queries(queries) if isinstance(queries, list) else queries
+    T = sindex.tile
+    # round UP like _tier_caps does for serving, so the probe times the
+    # same gather width serving actually performs
+    cap = min(-(-(window_cap or T) // T) * T, (sindex.MAX_C - 1) * T)
+    lo, hi = _window_bounds(sindex, enc)
+    q8, _nh = pack_q8(enc, lo, hi)
+    tile_ids = (lo // T).astype(np.int32)
+    b = len(tile_ids)
+    nslots = CHUNK_SMALL if b <= CHUNK_SMALL else CHUNK
+    single = (np.maximum(hi, lo + 1) - 1) // T <= tile_ids
+    is_exact = enc["alt_mode"] == MODE_EXACT
+
+    def cycle(sel):
+        reps = -(-nslots // len(sel))
+        idx = np.tile(sel, reps)[:nslots]
+        return tile_ids[idx], q8[idx]
+
+    per = 0.0
+    gathered = 0.0
+    for mask, C, tier_cap in (
+        (single, 1, T),
+        (~single, None, cap),
+    ):
+        for exact in (True, False):
+            sel = np.flatnonzero(mask & (is_exact == exact))
+            share = len(sel) / b
+            if share == 0.0:
+                continue
+            t_ids, qs = cycle(sel)
+            p, g = _probe_one_tier(
+                sindex,
+                t_ids,
+                qs,
+                cap=tier_cap,
+                C=C,
+                iters=iters,
+                exact_only=exact,
+            )
+            per += share * p
+            gathered += share * g
+    return per, int(gathered)
