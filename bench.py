@@ -207,6 +207,26 @@ def config2_point_queries(shard, sindex):
     return headline, detail
 
 
+def _run_colocated_probe(script: str):
+    """Run an embedded probe script in a CPU-backend subprocess (no
+    tunnel) and parse its final 'p50_ms=' line; None on failure."""
+    import subprocess
+
+    proc = subprocess.run(
+        [sys.executable, "-c", script],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    lines = proc.stdout.strip().splitlines()
+    line = lines[-1] if lines else ""
+    if line.startswith("p50_ms="):
+        return round(float(line.split("=")[1]), 3)
+    print(proc.stderr[-500:], file=sys.stderr)
+    return None
+
+
 def config1_single_snv(shard, sindex):
     """Single SNV exists-query p50 through the engine + oracle parity
     (the parity oracle runs on a small independent record corpus —
@@ -220,7 +240,11 @@ def config1_single_snv(shard, sindex):
     from sbeacon_tpu.testing import random_records
 
     engine = VariantEngine(
-        BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
+        BeaconConfig(
+            engine=EngineConfig(
+                use_mesh=False, microbatch=False, device_planes=False
+            )
+        )
     )
     engine.add_prebuilt_index(shard, sindex)
     import numpy as np
@@ -230,7 +254,12 @@ def config1_single_snv(shard, sindex):
     rng = random.Random(23)
     pos = shard.cols["pos"]
     # alternateBases='N' matches single-base alts only: query those rows
-    sb = np.flatnonzero(shard.cols["flags"] & FLAG.SINGLE_BASE)
+    # (ac>0 — the assert below wants guaranteed hits, and the synthetic
+    # allele-frequency spectrum legitimately produces AC=0 rows)
+    sb = np.flatnonzero(
+        (shard.cols["flags"] & FLAG.SINGLE_BASE).astype(bool)
+        & (shard.cols["ac"] > 0)
+    )
     lat = []
     for _ in range(30):
         r = int(sb[rng.randrange(len(sb))])
@@ -303,21 +332,9 @@ def config1_single_snv(shard, sindex):
     # co-located full-stack p50 on the CPU backend (no tunnel): evidences
     # the <10 ms north-star is transport-bound, not framework-bound
     try:
-        import subprocess
-
-        proc = subprocess.run(
-            [sys.executable, "-c", _COLOCATED_PROBE],
-            capture_output=True,
-            text=True,
-            timeout=300,
-            env={**os.environ, "JAX_PLATFORMS": "cpu"},
-        )
-        lines = proc.stdout.strip().splitlines()
-        line = lines[-1] if lines else ""
-        if line.startswith("p50_ms="):
-            out["colocated_cpu_p50_ms"] = round(float(line.split("=")[1]), 3)
-        else:
-            print(proc.stderr[-500:], file=sys.stderr)
+        p50 = _run_colocated_probe(_COLOCATED_PROBE)
+        if p50 is not None:
+            out["colocated_cpu_p50_ms"] = p50
     except Exception:
         traceback.print_exc(file=sys.stderr)
     return out
@@ -593,12 +610,42 @@ def config7_selected_samples(shard, sindex):
     )
     from sbeacon_tpu.config import BeaconConfig, EngineConfig
     from sbeacon_tpu.ops.kernel import QuerySpec
+    from sbeacon_tpu.ops.plane_kernel import (
+        PlaneDeviceIndex,
+        device_plane_probe,
+        plane_row_stats,
+    )
     from sbeacon_tpu.payloads import VariantQueryPayload
 
+    import numpy as np
+
+    # device-resident genotype planes (VERDICT r3 #2): ONE upload shared
+    # by the p50 engine, the probe, and the materialisation comparison.
+    # The INFO-sourced corpus needs only the gt plane on device
+    # (PlaneDeviceIndex skips count planes the counting path never
+    # reads); full-width residency at 2e7 rows is ~10 GB HBM padded.
+    t0 = time.perf_counter()
+    try:
+        pindex = PlaneDeviceIndex(shard)
+        import jax
+
+        # this backend's block_until_ready returns early — device_get of
+        # one element is the established completion sync
+        np.asarray(jax.device_get(pindex.gt[0, :1]))
+        plane_upload_s = time.perf_counter() - t0
+        plane_err = None
+    except Exception as e:  # HBM pressure: keep the host path honest
+        traceback.print_exc(file=sys.stderr)
+        pindex = None
+        plane_upload_s = None
+        plane_err = repr(e)
+
     engine = VariantEngine(
-        BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
+        BeaconConfig(
+            engine=EngineConfig(use_mesh=False, microbatch=False)
+        )
     )
-    engine.add_prebuilt_index(shard, sindex)
+    engine.add_prebuilt_index(shard, sindex, planes=pindex)
     rng = random.Random(31)
     names = shard.meta["sample_names"]
     selected = [names[rng.randrange(len(names))] for _ in range(100)]
@@ -628,13 +675,63 @@ def config7_selected_samples(shard, sindex):
         "n_selected": len(selected),
         "plane_width_words": int(shard.gt_bits.shape[1]),
         "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+        "device_planes": pindex is not None,
     }
+    if pindex is not None:
+        out["plane_hbm_gb"] = round(pindex.nbytes_hbm() / 1e9, 2)
+        out["plane_upload_s"] = round(plane_upload_s, 1)
+    else:
+        out["plane_error"] = plane_err
+
+    # host-plane comparison engine (the round-3 path): on a tunnel box
+    # each device plane reduction costs a full RTT, so the end-to-end
+    # p50 split shows transport, not framework — the co-located probe
+    # below and the device-time probe are the framework numbers
+    engine_host = VariantEngine(
+        BeaconConfig(
+            engine=EngineConfig(
+                use_mesh=False, microbatch=False, device_planes=False
+            )
+        )
+    )
+    engine_host.add_prebuilt_index(shard, sindex)
+    lat_h = []
+    rng_h = random.Random(31)
+    for _ in range(15):
+        r = rng_h.randrange(shard.n_rows)
+        payload = VariantQueryPayload(
+            dataset_ids=["bench1kg"],
+            reference_name=shard.row_chrom(r),
+            start_min=max(1, int(pos[r]) - 2000),
+            start_max=int(pos[r]) + 2000,
+            end_min=1,
+            end_max=2**30,
+            alternate_bases="N",
+            requested_granularity="record",
+            include_datasets="HIT",
+            include_samples=True,
+            selected_samples_only=True,
+            sample_names={"bench1kg": selected},
+        )
+        t0 = time.perf_counter()
+        engine_host.search(payload)
+        lat_h.append(time.perf_counter() - t0)
+    lat_h.sort()
+    out["p50_host_planes_ms"] = round(lat_h[len(lat_h) // 2] * 1e3, 2)
+    engine_host.close()
+
+    # co-located probe (CPU backend subprocess, no tunnel): the same
+    # selected-samples path with device planes, RTT-free
+    try:
+        p50 = _run_colocated_probe(_COLOCATED_SELECTED_PROBE)
+        if p50 is not None:
+            out["colocated_cpu_p50_ms"] = p50
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
 
     # wide record query -> 1e4+ matched rows, host materialisation path
     # (window chosen inside ONE chromosome segment: positions reset per
     # chromosome, so a row range crossing a boundary would be empty)
-    import numpy as np
-
     seg_sizes = np.diff(shard.chrom_offsets)
     code = int(np.argmax(seg_sizes))  # biggest chromosome segment
     a = int(shard.chrom_offsets[code])
@@ -677,7 +774,78 @@ def config7_selected_samples(shard, sindex):
         "speedup": round(t_loop / t_vec, 1) if t_vec else None,
         "parity": a == b,
     }
+    if pindex is not None:
+        # same wide materialisation with the plane reads on-device
+        t_dev = _time_batch(
+            lambda: materialize_response(
+                shard, rows, payload, plane_index=pindex, **kw
+            ),
+            repeats=3,
+        )
+        d = materialize_response(
+            shard, rows, payload, plane_index=pindex, **kw
+        )
+        out["materialize_1e4_rows"]["device_ms"] = round(t_dev * 1e3, 2)
+        out["materialize_1e4_rows"]["device_parity"] = d == b
+
+        # device-only time for one 1024-row masked plane reduction
+        # (popcounts + sample-hit OR), chain-differenced
+        from sbeacon_tpu.ops.plane_kernel import sample_mask_words
+
+        sel_idx = [names.index(sn) for sn in set(selected)]
+        mask_words = sample_mask_words(sel_idx, pindex.n_words)
+        probe_rows = rows[:1024].astype(np.int32)
+        # warm the stats path the p50 queries use, then probe
+        plane_row_stats(pindex, probe_rows, mask_words)
+        try:
+            per = device_plane_probe(
+                pindex, probe_rows, mask_words, iters=96
+            )
+            out["device_plane_us_per_1024_rows"] = round(per * 1e6, 2)
+        except Exception:
+            traceback.print_exc(file=sys.stderr)
     return out
+
+
+
+
+_COLOCATED_SELECTED_PROBE = """
+import jax
+jax.config.update("jax_platforms", "cpu")
+import random, time
+from sbeacon_tpu.config import BeaconConfig, EngineConfig
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import synthetic_shard
+
+shard = synthetic_shard(
+    2_000_000, n_samples=256, with_gt_planes=True, plane_density=0.25,
+    seed=7, dataset_id="co")
+engine = VariantEngine(BeaconConfig(engine=EngineConfig(use_mesh=False)))
+engine.add_index(shard)
+assert next(iter(engine._indexes.values()))[2] is not None
+names = shard.meta["sample_names"]
+rng = random.Random(31)
+selected = [names[rng.randrange(len(names))] for _ in range(50)]
+pos = shard.cols["pos"]
+lat = []
+for i in range(25):
+    r = rng.randrange(shard.n_rows)
+    payload = VariantQueryPayload(
+        dataset_ids=["co"], reference_name=shard.row_chrom(r),
+        start_min=max(1, int(pos[r]) - 2000), start_max=int(pos[r]) + 2000,
+        end_min=1, end_max=2**30, alternate_bases="N",
+        requested_granularity="record", include_datasets="HIT",
+        include_samples=True, selected_samples_only=True,
+        sample_names={"co": selected})
+    t0 = time.perf_counter()
+    engine.search(payload)
+    if i >= 5:
+        lat.append(time.perf_counter() - t0)
+lat.sort()
+print(f"p50_ms={lat[len(lat)//2]*1e3:.3f}")
+"""
+
 
 
 def config8_skew():
@@ -736,7 +904,10 @@ def config9_soak(shard, sindex):
         cfg = BeaconConfig(
             storage=StorageConfig(root=Path(td)),
             engine=EngineConfig(
-                use_mesh=False, microbatch=True, microbatch_wait_ms=10.0
+                use_mesh=False,
+                microbatch=True,
+                microbatch_wait_ms=10.0,
+                device_planes=False,
             ),
         )
         cfg.storage.ensure()

@@ -119,6 +119,13 @@ class EngineConfig:
     microbatch: bool = True
     microbatch_max: int = 512
     microbatch_wait_ms: float = 0.0
+    # device-resident genotype planes (selected-samples leaf): upload a
+    # shard's bit planes to HBM when their padded size fits the budget;
+    # oversized plane sets stay host-resident (round-3 numpy path). The
+    # budget leaves room for the column tiles + kernel workspace on a
+    # 16 GB v5e.
+    device_planes: bool = True
+    plane_hbm_budget_gb: float = 11.0
 
 
 @dataclasses.dataclass(frozen=True)

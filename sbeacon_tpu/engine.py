@@ -212,9 +212,9 @@ def materialize_response_loop(
 
     mask = None
     if selected_idx is not None and shard.gt_bits is not None:
-        mask = np.zeros(shard.gt_bits.shape[1], dtype=np.uint32)
-        for si in selected_idx:
-            mask[si // 32] |= np.uint32(1 << (si % 32))
+        from .ops.plane_kernel import sample_mask_words
+
+        mask = sample_mask_words(selected_idx, shard.gt_bits.shape[1])
     # restricted genotype-derived counting needs the full plane set; a
     # shard persisted before the count planes existed degrades to the
     # full-cohort baked counts (sample extraction still restricts)
@@ -360,6 +360,7 @@ def materialize_response(
     dataset_id: str = "",
     vcf_location: str = "",
     selected_idx: list[int] | None = None,
+    plane_index=None,
 ) -> VariantSearchResponse:
     """Vectorised row-id materialisation (cumulative-order semantics).
 
@@ -372,6 +373,14 @@ def materialize_response(
     remain a comprehension over matched rows only — they ARE the response
     payload, so their count is already bounded by what the client asked
     to receive.
+
+    ``plane_index`` (an ``ops.plane_kernel.PlaneDeviceIndex``) moves the
+    plane reads on-device: per-row masked popcounts and the sample-hit
+    OR run as one-or-two jitted gather programs over HBM-resident
+    planes instead of numpy over the ~n_rows x n_samples/8 host arrays.
+    The truncation/AN/overflow semantics are computed on host from the
+    device-returned scalars and are bit-identical to the host path (the
+    ploidy>2 overflow side tables stay host-applied either way).
     """
     c = shard.cols
     rows = np.asarray(rows, dtype=np.int64)
@@ -381,9 +390,9 @@ def materialize_response(
     n_words = shard.gt_bits.shape[1] if shard.gt_bits is not None else 0
     mask = None
     if selected_idx is not None and shard.gt_bits is not None:
-        mask = np.zeros(n_words, dtype=np.uint32)
-        for si in selected_idx:
-            mask[si // 32] |= np.uint32(1 << (si % 32))
+        from .ops.plane_kernel import sample_mask_words
+
+        mask = sample_mask_words(selected_idx, n_words)
     count_planes = (
         mask is not None
         and shard.gt_bits2 is not None
@@ -419,17 +428,41 @@ def materialize_response(
     # per-row call contribution (the loop's rc)
     ac_rows = c["ac"][rows].astype(np.int64)
     rc = ac_rows.copy()
-    if count_planes:
-        # popcount only the rows that actually use genotype-derived
-        # counts (INFO-sourced shards would otherwise pay full plane
-        # reads that np.where throws away)
-        gt_rows = np.flatnonzero((c["flags"][rows] & FLAG.AC_INFO) == 0)
-        if len(gt_rows):
-            rr = rows[gt_rows]
+    r0 = rows[starts]
+    gt_rows = (
+        np.flatnonzero((c["flags"][rows] & FLAG.AC_INFO) == 0)
+        if count_planes
+        else np.zeros(0, np.int64)
+    )
+    tok_grps = (
+        np.flatnonzero((c["flags"][r0] & FLAG.AN_INFO) == 0)
+        if count_planes
+        else np.zeros(0, np.int64)
+    )
+    dev_counts = None
+    if (
+        plane_index is not None
+        and plane_index.has_counts
+        and (len(gt_rows) or len(tok_grps))
+    ):
+        # ONE device call covers both popcount target sets (matched
+        # rows needing genotype-derived AC, record-first rows needing
+        # token-derived AN)
+        from .ops.plane_kernel import plane_row_stats
+
+        cat = np.concatenate([rows[gt_rows], r0[tok_grps]])
+        dev_counts, _ = plane_row_stats(plane_index, cat, mask)
+    if count_planes and len(gt_rows):
+        rr = rows[gt_rows]
+        extras = _overflow_extras(shard, "gt", rr, sel_mask)
+        if dev_counts is not None:
+            pc = dev_counts[: len(gt_rows)]
+            rc[gt_rows] = pc[:, 0] + pc[:, 1] + extras
+        else:
             rc[gt_rows] = (
                 _popcounts(shard.gt_bits[rr], mask)
                 + _popcounts(shard.gt_bits2[rr], mask)
-                + _overflow_extras(shard, "gt", rr, sel_mask)
+                + extras
             )
 
     rc_grp = np.add.reduceat(rc, starts)
@@ -438,16 +471,18 @@ def materialize_response(
     k0 = int(np.argmax(cum > 0)) if exists else n_grp - 1
 
     # per-record AN (from each record's first row)
-    r0 = rows[starts]
     an_grp = c["an"][r0].astype(np.int64)
-    if count_planes:
-        tok_grps = np.flatnonzero((c["flags"][r0] & FLAG.AN_INFO) == 0)
-        if len(tok_grps):
-            rr = r0[tok_grps]
+    if count_planes and len(tok_grps):
+        rr = r0[tok_grps]
+        extras = _overflow_extras(shard, "tok", rr, sel_mask)
+        if dev_counts is not None:
+            tk = dev_counts[len(gt_rows) :]
+            an_grp[tok_grps] = tk[:, 2] + tk[:, 3] + extras
+        else:
             an_grp[tok_grps] = (
                 _popcounts(shard.tok_bits1[rr], mask)
                 + _popcounts(shard.tok_bits2[rr], mask)
-                + _overflow_extras(shard, "tok", rr, sel_mask)
+                + extras
             )
 
     # cumulative truncation: which records the loop would process
@@ -498,9 +533,22 @@ def materialize_response(
         and shard.gt_bits is not None
     ):
         srows = rows[grp_of >= k0]
-        agg = np.bitwise_or.reduce(shard.gt_bits[srows], axis=0)
-        if mask is not None:
-            agg = agg & mask
+        if plane_index is not None:
+            # device OR-reduction over the exact grp>=k0 subset (k0 is
+            # host-known by now in every case, so one dispatch is exact)
+            from .ops.plane_kernel import plane_row_stats
+
+            _cnts, agg = plane_row_stats(
+                plane_index,
+                srows,
+                mask,
+                or_sel=np.ones(len(srows), np.int32),
+                with_counts=False,
+            )
+        else:
+            agg = np.bitwise_or.reduce(shard.gt_bits[srows], axis=0)
+            if mask is not None:
+                agg = agg & mask
         bits = np.unpackbits(
             agg.view(np.uint8), bitorder="little"
         ).astype(bool)
@@ -542,8 +590,13 @@ class VariantEngine:
 
     def __init__(self, config: BeaconConfig | None = None):
         self.config = config or BeaconConfig()
-        # (dataset_id, vcf_location) -> (shard, DeviceIndex)
-        self._indexes: dict[tuple[str, str], tuple[VariantIndexShard, DeviceIndex]] = {}
+        # (dataset_id, vcf_location) -> (shard, DeviceIndex|None,
+        # PlaneDeviceIndex|None) — ONE atomic triple per key: a search
+        # must never pair a shard snapshot with a plane index from a
+        # different (re-)ingestion, so they live in the same value
+        self._indexes: dict[
+            tuple[str, str], tuple[VariantIndexShard, object, object]
+        ] = {}
         eng = self.config.engine
         if eng.microbatch:
             from .serving import MicroBatcher
@@ -571,6 +624,45 @@ class VariantEngine:
 
     # -- index management ---------------------------------------------------
 
+    def _build_planes(self, key, shard, dindex):
+        """Device-resident genotype planes for the selected-samples leaf
+        (ops/plane_kernel.py), gated on the HBM budget — oversized plane
+        sets stay host-resident and materialisation falls back to the
+        numpy path exactly as before."""
+        eng = self.config.engine
+        if (
+            dindex is None
+            or shard.gt_bits is None
+            or not getattr(eng, "device_planes", True)
+        ):
+            return None
+        from .ops.plane_kernel import PlaneDeviceIndex
+
+        budget = getattr(eng, "plane_hbm_budget_gb", 11.0) * 1e9
+        # CUMULATIVE gate: other shards' resident planes count against
+        # the budget too (re-ingestion of this key frees its old set)
+        with self._mesh_lock:
+            used = sum(
+                p.nbytes_hbm()
+                for k, (_s, _d, p) in self._indexes.items()
+                if p is not None and k != key
+            )
+        if used + PlaneDeviceIndex.estimate_hbm(shard) > budget:
+            logging.getLogger(__name__).info(
+                "genotype planes for %s exceed HBM budget "
+                "(%.1f GB resident); host-resident",
+                key,
+                used / 1e9,
+            )
+            return None
+        try:
+            return PlaneDeviceIndex(shard)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "plane upload failed for %s; host-resident", key
+            )
+            return None
+
     def add_index(self, shard: VariantIndexShard) -> None:
         key = (shard.meta.get("dataset_id", ""), shard.meta.get("vcf_location", ""))
         try:
@@ -588,21 +680,31 @@ class VariantEngine:
                 key,
             )
             dindex = None
+        planes = self._build_planes(key, shard, dindex)
         # publish + dirty-mark in one critical section: a concurrent
         # search must never pair the new shard with a mesh stack built
         # from the old one (_mesh_ready reads _indexes under this lock)
         with self._mesh_lock:
             self._mesh_dirty = True
-            self._indexes[key] = (shard, dindex)
+            self._indexes[key] = (shard, dindex, planes)
 
-    def add_prebuilt_index(self, shard: VariantIndexShard, dindex) -> None:
+    _AUTO_PLANES = object()  # sentinel: build planes unless caller chose
+
+    def add_prebuilt_index(
+        self, shard: VariantIndexShard, dindex, planes=_AUTO_PLANES
+    ) -> None:
         """Register a shard with an ALREADY-BUILT device index (benchmarks
         and bulk loaders that construct/upload the index out of band) —
-        keeps the private ``_indexes`` key/locking contract in one place."""
+        keeps the private ``_indexes`` key/locking contract in one place.
+        ``planes`` may be an out-of-band PlaneDeviceIndex or an explicit
+        None (no plane upload even if the budget allows — e.g. the
+        caller already tried and failed); omitted means auto-build."""
         key = (shard.meta.get("dataset_id", ""), shard.meta.get("vcf_location", ""))
+        if planes is VariantEngine._AUTO_PLANES:
+            planes = self._build_planes(key, shard, dindex)
         with self._mesh_lock:
             self._mesh_dirty = True
-            self._indexes[key] = (shard, dindex)
+            self._indexes[key] = (shard, dindex, planes)
 
     def close(self) -> None:
         """Release the scatter pool (same contract as
@@ -616,7 +718,7 @@ class VariantEngine:
         """Identity of the loaded index set; folds into async-query cache
         keys so cached results are invalidated by any (re-)ingestion."""
         parts = []
-        for (ds, vcf), (shard, _) in sorted(self._indexes.items()):
+        for (ds, vcf), (shard, *_rest) in sorted(self._indexes.items()):
             parts.append(
                 f"{ds}|{vcf}|{shard.meta.get('variant_count')}"
                 f"|{shard.meta.get('call_count')}|{shard.n_rows}"
@@ -683,13 +785,15 @@ class VariantEngine:
             variant_max_length=payload.variant_max_length,
         )
         targets = []
-        for ds, vcf, (shard, dindex) in self.indexes_for(payload.dataset_ids):
+        for ds, vcf, (shard, dindex, planes) in self.indexes_for(
+            payload.dataset_ids
+        ):
             native = shard.meta.get("chrom_native", {}).get(payload.reference_name)
             if native is None:
                 # VCF has no matching chromosome: skipped, like the
                 # get_matching_chromosome filter (search_variants.py:81-85)
                 continue
-            targets.append((ds, vcf, shard, dindex, native))
+            targets.append((ds, vcf, shard, dindex, planes, native))
         if not targets:
             return []
 
@@ -706,7 +810,7 @@ class VariantEngine:
                     )
 
         def _one_target(target):
-            ds, vcf, shard, dindex, native = target
+            ds, vcf, shard, dindex, planes, native = target
             selected_idx = None
             if payload.selected_samples_only:
                 # selected-samples leaf (reference performQuery/
@@ -739,6 +843,7 @@ class VariantEngine:
                 dataset_id=ds,
                 vcf_location=vcf,
                 selected_idx=selected_idx,
+                plane_index=planes,
             )
 
         if len(targets) == 1:
@@ -801,8 +906,11 @@ class VariantEngine:
                 # shard objects the stack was built from, never against
                 # a concurrently re-ingested replacement
                 shard_of = dict(zip(keys, shards))
+                planes_of = {k: self._indexes[k][2] for k in keys}
                 index_of = {k: i for i, k in enumerate(keys)}
-                self._mesh_state = (mesh, stacked, arrays, index_of, shard_of)
+                self._mesh_state = (
+                    mesh, stacked, arrays, index_of, shard_of, planes_of
+                )
             except Exception:
                 logging.getLogger(__name__).exception(
                     "mesh serving unavailable; using thread scatter"
@@ -820,7 +928,7 @@ class VariantEngine:
         scatter path."""
         from .parallel.mesh import sharded_query
 
-        mesh, stacked, arrays, index_of, shard_of = state
+        mesh, stacked, arrays, index_of, shard_of, planes_of = state
         eng = self.config.engine
         per_ds, agg = sharded_query(
             arrays,
@@ -834,7 +942,7 @@ class VariantEngine:
         ref_wild = payload.selected_samples_only
 
         def _one(target):
-            ds, vcf, _shard, _dindex, native = target
+            ds, vcf, _shard, _dindex, _planes, native = target
             # state-consistent shard: rows from the stacked arrays must
             # materialise against the shard the stack was built from (a
             # missing key means the dataset arrived after the stack was
@@ -865,6 +973,7 @@ class VariantEngine:
                 dataset_id=ds,
                 vcf_location=vcf,
                 selected_idx=selected_idx,
+                plane_index=planes_of.get((ds, vcf)),
             )
 
         if len(targets) == 1:

@@ -68,6 +68,7 @@ class StackedIndex:
         *,
         n_datasets_padded: int | None = None,
         pad_unit: int = DeviceIndex.PAD_UNIT,
+        with_planes: bool = False,
     ):
         if not shards:
             raise ValueError("StackedIndex needs at least one shard")
@@ -101,6 +102,41 @@ class StackedIndex:
             + [np.zeros(N_CHROM_CODES + 1, np.int32)] * (d_pad - d)
         )
         self.n_iters = bisect_iters(n_pad)
+
+        # genotype planes, dataset-sharded WITH their index rows: each
+        # device holds the planes of the datasets it owns (the 25 GB
+        # 1000-Genomes plane set fits a pod by construction — ~3 GB per
+        # chip on 8 devices). W is padded to the widest shard; absent
+        # planes stack as zeros for padding datasets.
+        self.plane_words = 0
+        self.has_planes = False
+        self.has_count_planes = False
+        if with_planes and all(s.gt_bits is not None for s in shards):
+            W = max(s.gt_bits.shape[1] for s in shards)
+            self.plane_words = W
+            self.has_planes = True
+            self.has_count_planes = all(
+                s.gt_bits2 is not None
+                and s.tok_bits1 is not None
+                and s.tok_bits2 is not None
+                for s in shards
+            )
+
+            def stackp(attr):
+                # fill one preallocated block: per-shard padded copies +
+                # np.stack would transiently double the (multi-GB) host
+                # footprint of a 1000-Genomes plane set
+                out = np.zeros((d_pad, n_pad, W), np.uint32)
+                for di, sh in enumerate(shards):
+                    a = getattr(sh, attr)
+                    out[di, : a.shape[0], : a.shape[1]] = a
+                return out.view(np.int32)
+
+            self.arrays["plane_gt"] = stackp("gt_bits")
+            if self.has_count_planes:
+                self.arrays["plane_gt2"] = stackp("gt_bits2")
+                self.arrays["plane_tok1"] = stackp("tok_bits1")
+                self.arrays["plane_tok2"] = stackp("tok_bits2")
 
     def shard_to_mesh(self, mesh: Mesh, axis: str = AXIS) -> dict:
         """Device-put the stack with axis 0 partitioned over ``axis``."""
@@ -140,6 +176,143 @@ def _local_query(arrays_local, enc, *, window_cap, record_cap, n_iters, axis):
         ),
         "n_datasets_hit": jax.lax.psum(
             jnp.sum(per_ds["exists"].astype(jnp.int32), axis=0), axis
+        ),
+        "n_overflow": jax.lax.psum(
+            jnp.sum(per_ds["overflow"].astype(jnp.int32), axis=0), axis
+        ),
+    }
+    agg["exists"] = agg["call_count"] > 0
+    return per_ds, agg
+
+
+def _local_selected(
+    arrays_local,
+    enc,
+    masks_local,
+    *,
+    window_cap,
+    record_cap,
+    n_iters,
+    axis,
+    has_counts,
+):
+    """Selected-samples body per device: match rows, then reduce each
+    dataset's LOCAL genotype planes under its sample mask — popcount
+    counting for genotype-derived rows, AN from token planes, and the
+    sample-hit OR over the exact ``record-cumulative > 0`` row subset
+    (the same ``grp >= k0`` selection materialize_response uses).
+
+    The planes never leave their owning device: only [B]-scalar
+    aggregates cross the mesh (psum), the per-dataset sample words stay
+    sharded. Ploidy>2 saturation side-tables are host-only — callers
+    needing those exact values use the per-dataset engine path.
+    """
+    from ..index.columnar import FLAG
+
+    def one_dataset(arrays_one, mask_one):
+        res = jax.vmap(
+            partial(
+                _query_one,
+                arrays_one,
+                window_cap=window_cap,
+                record_cap=record_cap,
+                n_iters=n_iters,
+            )
+        )(enc)
+        rows = res["rows"]  # [B, R] int32, -1 padded
+        valid = rows >= 0
+        n = arrays_one["pos"].shape[0]
+        safe = jnp.clip(rows, 0, n - 1)
+        flags_r = arrays_one["flags"][safe]
+        ac_r = arrays_one["ac"][safe].astype(jnp.int32)
+        an_r = arrays_one["an"][safe].astype(jnp.int32)
+        rec_r = arrays_one["rec_id"][safe]
+        m = mask_one[None, None, :]  # [1, 1, W]
+        gt = arrays_one["plane_gt"][safe] & m  # [B, R, W]
+        pcw = lambda x: jnp.sum(
+            jax.lax.population_count(x), axis=-1
+        ).astype(jnp.int32)
+        if has_counts:
+            pc_call = pcw(gt) + pcw(arrays_one["plane_gt2"][safe] & m)
+            pc_tok = pcw(arrays_one["plane_tok1"][safe] & m) + pcw(
+                arrays_one["plane_tok2"][safe] & m
+            )
+            rc = jnp.where((flags_r & FLAG.AC_INFO) != 0, ac_r, pc_call)
+            an_eff = jnp.where(
+                (flags_r & FLAG.AN_INFO) != 0, an_r, pc_tok
+            )
+        else:
+            rc = ac_r
+            an_eff = an_r
+        rc = rc * valid
+        call_count = jnp.sum(rc, axis=1)
+
+        # record boundaries among the (sorted, -1-tail-padded) matched
+        # rows: padding lanes clip to row 0, whose rec_id can ALIAS a
+        # real matched record — give invalid lanes an impossible id so
+        # segment boundaries never cross the valid/padding edge
+        rec_eff = jnp.where(valid, rec_r, jnp.int32(-2))
+        first = valid & jnp.concatenate(
+            [
+                jnp.ones_like(valid[:, :1]),
+                rec_eff[:, 1:] != rec_eff[:, :-1],
+            ],
+            axis=1,
+        )
+        alleles = jnp.sum(jnp.where(first, an_eff, 0), axis=1)
+
+        # sample-hit OR over materialize_response's exact grp >= k0 row
+        # subset: a row participates iff the cumulative rc BEFORE its
+        # record (base) is positive, or ANY row of its own record has
+        # rc > 0. Both come from segmented prefix scans (the flipped
+        # pass covers 'positive rc later in my record').
+        c = jnp.cumsum(rc, axis=1)
+        before = c - rc
+        base = jax.lax.cummax(
+            jnp.where(first, before, jnp.int32(-1)), axis=1
+        )
+        fwd_any = (c - base) > 0  # rc>0 at-or-before me, in my record
+        rc_f = jnp.flip(rc, axis=1)
+        first_f = jnp.flip(valid, axis=1) & jnp.concatenate(
+            [
+                jnp.ones_like(valid[:, :1]),
+                jnp.flip(rec_eff, axis=1)[:, 1:]
+                != jnp.flip(rec_eff, axis=1)[:, :-1],
+            ],
+            axis=1,
+        )
+        c_f = jnp.cumsum(rc_f, axis=1)
+        base_f = jax.lax.cummax(
+            jnp.where(first_f, c_f - rc_f, jnp.int32(-1)), axis=1
+        )
+        bwd_any = jnp.flip((c_f - base_f) > 0, axis=1)
+        or_sel = valid & ((base > 0) | fwd_any | bwd_any)
+        or_words = jax.lax.reduce(
+            jnp.where(or_sel[:, :, None], gt, jnp.int32(0)),
+            np.int32(0),
+            jax.lax.bitwise_or,
+            dimensions=(1,),
+        )  # [B, W]
+        # window overflow OR record_cap truncation: the plane sums above
+        # only cover the returned [record_cap] rows, so a truncated row
+        # set silently undercounts unless flagged (the engine's scatter
+        # path applies the same n_matched guard)
+        trunc = res["n_matched"] > jnp.int32(record_cap)
+        return {
+            "call_count": call_count,
+            "all_alleles_count": alleles,
+            "or_words": or_words,
+            "overflow": res["overflow"] | trunc,
+            "n_matched": res["n_matched"],
+        }
+
+    per_ds = jax.vmap(one_dataset)(arrays_local, masks_local)
+    agg = {
+        "call_count": jax.lax.psum(
+            jnp.sum(per_ds["call_count"], axis=0), axis
+        ),
+        "all_alleles_count": jax.lax.psum(
+            jnp.sum(per_ds["all_alleles_count"], axis=0), axis
         ),
         "n_overflow": jax.lax.psum(
             jnp.sum(per_ds["overflow"].astype(jnp.int32), axis=0), axis
@@ -203,6 +376,75 @@ def sharded_query(
     enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
     fn = _build_sharded_fn(mesh, axis, window_cap, record_cap, n_iters)
     per_ds, agg = fn(stacked_arrays, enc_dev)
+    agg = jax.device_get(agg)
+    if aggregates_only:
+        per_out: dict = {}
+    else:
+        per_ds = jax.device_get(per_ds)
+        per_out = {k: np.asarray(v) for k, v in per_ds.items()}
+    return per_out, {k: np.asarray(v) for k, v in agg.items()}
+
+
+def sharded_selected_query(
+    stacked_arrays: dict,
+    queries,
+    sample_masks: np.ndarray,
+    *,
+    mesh: Mesh,
+    n_iters: int,
+    axis: str = AXIS,
+    window_cap: int = 2048,
+    record_cap: int = 1024,
+    has_counts: bool = False,
+    aggregates_only: bool = False,
+):
+    """Selected-samples query batch over mesh-sharded planes.
+
+    ``sample_masks``: uint32 [D, W] — dataset d's selected-sample bit
+    mask (sharded over the mesh axis with its planes). Returns
+    (per_dataset, aggregates): per-dataset ``or_words`` [D, B, W] are
+    the masked sample-hit unions, aggregates are psum'd selected
+    call/allele counts. ``n_overflow > 0`` means a window overflowed
+    and the caller must re-answer those datasets host-side, as in
+    ``sharded_query``.
+    """
+    enc = (
+        encode_queries(queries) if isinstance(queries, list) else queries
+    )
+    enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
+    masks_dev = jax.device_put(
+        jnp.asarray(np.asarray(sample_masks, np.uint32).view(np.int32)),
+        NamedSharding(mesh, P(axis)),
+    )
+    key = (
+        "selected",
+        mesh,
+        axis,
+        window_cap,
+        record_cap,
+        n_iters,
+        has_counts,
+    )
+    fn = _FN_CACHE.get(key)
+    if fn is None:
+        body = partial(
+            _local_selected,
+            window_cap=window_cap,
+            record_cap=record_cap,
+            n_iters=n_iters,
+            axis=axis,
+            has_counts=has_counts,
+        )
+        fn = jax.jit(
+            jax.shard_map(
+                body,
+                mesh=mesh,
+                in_specs=(P(axis), P(), P(axis)),
+                out_specs=(P(axis), P()),
+            )
+        )
+        _FN_CACHE[key] = fn
+    per_ds, agg = fn(stacked_arrays, enc_dev, masks_dev)
     agg = jax.device_get(agg)
     if aggregates_only:
         per_out: dict = {}

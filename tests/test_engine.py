@@ -120,7 +120,7 @@ def test_dataset_filter(setup):
 
 def test_host_match_rows_agrees_with_kernel(setup):
     engine, recs = setup
-    (shard, dindex) = engine._indexes[("dsA", "a.vcf.gz")]
+    (shard, dindex, _planes) = engine._indexes[("dsA", "a.vcf.gz")]
     rng = random.Random(5)
     from sbeacon_tpu.ops import run_queries
 

@@ -170,7 +170,7 @@ def test_stale_shard_missing_planes(setup):
     """A shard with only the legacy carrier plane (no count planes) must
     not crash a selected-samples query — it degrades to baked counts."""
     engine, recs = setup
-    (shard, _), = [engine._indexes[k] for k in engine._indexes]
+    (shard, *_), = [engine._indexes[k] for k in engine._indexes]
     import dataclasses
 
     legacy = dataclasses.replace(
