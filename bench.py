@@ -650,9 +650,12 @@ def config7_selected_samples(shard, sindex):
     names = shard.meta["sample_names"]
     selected = [names[rng.randrange(len(names))] for _ in range(100)]
     pos = shard.cols["pos"]
+    # ONE query-row list shared by the device-planes and host-planes
+    # loops: the p50 split must compare plane residency, not different
+    # random genomic windows
+    query_rows = [rng.randrange(shard.n_rows) for _ in range(15)]
     lat = []
-    for _ in range(15):
-        r = rng.randrange(shard.n_rows)
+    for r in query_rows:
         payload = VariantQueryPayload(
             dataset_ids=["bench1kg"],
             reference_name=shard.row_chrom(r),
@@ -696,9 +699,7 @@ def config7_selected_samples(shard, sindex):
     )
     engine_host.add_prebuilt_index(shard, sindex)
     lat_h = []
-    rng_h = random.Random(31)
-    for _ in range(15):
-        r = rng_h.randrange(shard.n_rows)
+    for r in query_rows:
         payload = VariantQueryPayload(
             dataset_ids=["bench1kg"],
             reference_name=shard.row_chrom(r),

@@ -640,8 +640,15 @@ class VariantEngine:
 
         budget = getattr(eng, "plane_hbm_budget_gb", 11.0) * 1e9
         # CUMULATIVE gate: other shards' resident planes count against
-        # the budget too (re-ingestion of this key frees its old set)
+        # the budget. Re-ingestion must actually FREE the old set before
+        # the new upload (old+new coexisting would OOM a near-budget
+        # shard), so the key's entry is republished plane-less first —
+        # searches in that window take the host fallback, never a torn
+        # pairing.
         with self._mesh_lock:
+            prior = self._indexes.get(key)
+            if prior is not None and prior[2] is not None:
+                self._indexes[key] = (prior[0], prior[1], None)
             used = sum(
                 p.nbytes_hbm()
                 for k, (_s, _d, p) in self._indexes.items()

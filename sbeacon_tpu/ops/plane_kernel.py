@@ -69,12 +69,14 @@ class PlaneDeviceIndex:
     ``count_planes`` gate) and uploading them would waste HBM.
     """
 
-    def __init__(self, shard: VariantIndexShard):
-        if shard.gt_bits is None:
-            raise ValueError("shard has no genotype planes")
-        self.n_rows, self.n_words = shard.gt_bits.shape
+    @staticmethod
+    def wants_count_planes(shard: VariantIndexShard) -> bool:
+        """True when the shard can need genotype-derived counting: all
+        three count planes present AND at least one row without
+        INFO-sourced AC/AN. ONE predicate shared by the constructor and
+        the budget estimate so they can never drift."""
         flags = shard.cols["flags"]
-        self.has_counts = bool(
+        return bool(
             shard.gt_bits2 is not None
             and shard.tok_bits1 is not None
             and shard.tok_bits2 is not None
@@ -83,13 +85,20 @@ class PlaneDeviceIndex:
                 or ((flags & FLAG.AN_INFO) == 0).any()
             )
         )
-        # one padding row at the end: padded gather slots point at it
-        pad = np.zeros((1, self.n_words), np.uint32)
 
+    def __init__(self, shard: VariantIndexShard):
+        if shard.gt_bits is None:
+            raise ValueError("shard has no genotype planes")
+        self.n_rows, self.n_words = shard.gt_bits.shape
+        self.has_counts = self.wants_count_planes(shard)
+
+        # no padding row: padded gather slots point at row 0 — their
+        # count outputs are trimmed by the caller and their OR lanes
+        # carry or_sel=0, so the value read is never observed. (An
+        # appended zero row would cost a full host-side copy of the
+        # largest array in the system.)
         def up(a):
-            return jnp.asarray(
-                np.concatenate([a, pad]).view(np.int32)
-            )
+            return jnp.asarray(a.view(np.int32))
 
         self.gt = up(shard.gt_bits)
         if self.has_counts:
@@ -102,25 +111,19 @@ class PlaneDeviceIndex:
     def nbytes_hbm(self) -> int:
         """HBM bytes including XLA's 128-lane minor-dim padding."""
         w_pad = -(-self.n_words // 128) * 128
-        per = (self.n_rows + 1) * w_pad * 4
+        per = self.n_rows * w_pad * 4
         return per * (4 if self.has_counts else 1)
 
     @staticmethod
     def estimate_hbm(shard: VariantIndexShard) -> int:
-        """Upload-free HBM estimate for the capacity gate."""
+        """Upload-free HBM estimate for the capacity gate (same
+        count-plane predicate as the constructor)."""
         if shard.gt_bits is None:
             return 0
         n, w = shard.gt_bits.shape
         w_pad = -(-w // 128) * 128
-        flags = shard.cols["flags"]
-        has_counts = bool(
-            shard.gt_bits2 is not None
-            and (
-                ((flags & FLAG.AC_INFO) == 0).any()
-                or ((flags & FLAG.AN_INFO) == 0).any()
-            )
-        )
-        return (n + 1) * w_pad * 4 * (4 if has_counts else 1)
+        has_counts = PlaneDeviceIndex.wants_count_planes(shard)
+        return n * w_pad * 4 * (4 if has_counts else 1)
 
 
 @partial(jax.jit, static_argnames=("R", "with_counts", "with_or"))
@@ -129,8 +132,8 @@ def _plane_stats(
 ):
     """[R,4] per-row masked popcounts + [W] OR of gt&mask over or_sel.
 
-    ``rows`` int32[R] (padding slots point at the all-zero pad row),
-    ``or_sel`` int32[R] 0/1, ``mask`` int32[W]. Popcount columns:
+    ``rows`` int32[R] (padding slots point at row 0; callers discard
+    their outputs), ``or_sel`` int32[R] 0/1, ``mask`` int32[W]. Popcount columns:
     0=gt, 1=gt2, 2=tok1, 3=tok2 (count columns zero when the plane set
     has no count planes)."""
     m = mask[None, :]
@@ -201,8 +204,9 @@ def plane_row_stats(
             else np.zeros(pindex.n_words, np.uint32),
         )
     tier = next(t for t in _R_TIERS if R <= t)
-    pad_row = pindex.n_rows  # the all-zero padding row
-    rows_p = np.full(tier, pad_row, np.int32)
+    # pad slots target row 0: counts are trimmed to [:R], OR lanes carry
+    # or_sel=0, so the padded reads are never observed
+    rows_p = np.zeros(tier, np.int32)
     rows_p[:R] = rows
     sel_p = np.zeros(tier, np.int32)
     if or_sel is not None:
@@ -244,8 +248,7 @@ def device_plane_probe(
 
     R = len(rows)
     tier = next((t for t in _R_TIERS if R <= t), _R_TIERS[-1])
-    pad_row = pindex.n_rows
-    rows_p = np.full(tier, pad_row, np.int32)
+    rows_p = np.zeros(tier, np.int32)
     rows_p[: min(R, tier)] = rows[:tier]
     sel_p = np.ones(tier, np.int32)
     mask = jnp.asarray(
