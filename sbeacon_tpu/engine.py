@@ -649,6 +649,10 @@ class VariantEngine:
             prior = self._indexes.get(key)
             if prior is not None and prior[2] is not None:
                 self._indexes[key] = (prior[0], prior[1], None)
+            # drop the local reference too: it is the LAST holder of the
+            # old PlaneDeviceIndex, and its device arrays must actually
+            # free before the new upload claims HBM
+            prior = None  # noqa: F841
             used = sum(
                 p.nbytes_hbm()
                 for k, (_s, _d, p) in self._indexes.items()

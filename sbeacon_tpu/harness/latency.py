@@ -247,6 +247,11 @@ def run_concurrent_soak(
             "mean_batch": round(submits / launches, 2) if launches else 0.0,
             "histogram": hist,
         }
+        # tail attribution (VERDICT r3 #10): server-side queue wait vs
+        # device execute, so p99 is explainable as queueing behind
+        # in-flight launches vs dispatch/transport cost
+        if hasattr(batcher, "timing_summary"):
+            out["decomposition"] = batcher.timing_summary()
     if errors:
         out["first_errors"] = errors[:3]
     return out

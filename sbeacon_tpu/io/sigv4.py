@@ -34,7 +34,7 @@ import hashlib
 import hmac
 import os
 import time
-from urllib.parse import quote, unquote_plus, urlparse
+from urllib.parse import quote, unquote, urlparse
 
 UNSIGNED_PAYLOAD = "UNSIGNED-PAYLOAD"
 EMPTY_SHA256 = hashlib.sha256(b"").hexdigest()
@@ -58,11 +58,15 @@ def _canonical_query(query: str) -> str:
             continue
         k, _, v = part.partition("=")
         # re-encode from the decoded form so pre-encoded and raw inputs
-        # canonicalise identically
+        # canonicalise identically. unquote, NOT unquote_plus: '+' is a
+        # literal character in an RFC 3986 query — decoding it to space
+        # would make the canonical form diverge from the wire request
+        # and guarantee SignatureDoesNotMatch for any value with a raw
+        # '+'.
         pairs.append(
             (
-                _uri_encode(unquote_plus(k), encode_slash=True),
-                _uri_encode(unquote_plus(v), encode_slash=True),
+                _uri_encode(unquote(k), encode_slash=True),
+                _uri_encode(unquote(v), encode_slash=True),
             )
         )
     pairs.sort()

@@ -204,6 +204,9 @@ def plane_row_stats(
             else np.zeros(pindex.n_words, np.uint32),
         )
     tier = next(t for t in _R_TIERS if R <= t)
+    from . import scatter_kernel as _sk
+
+    _sk.N_DISPATCHES += 1
     # pad slots target row 0: counts are trimmed to [:R], OR lanes carry
     # or_sel=0, so the padded reads are never observed
     rows_p = np.zeros(tier, np.int32)

@@ -21,7 +21,9 @@ size.
 from __future__ import annotations
 
 import threading
+import time
 import weakref
+from collections import deque
 from dataclasses import dataclass
 
 import numpy as np
@@ -58,6 +60,7 @@ class _Pending:
     event: threading.Event
     result: object = None
     error: BaseException | None = None
+    t_submit: float = 0.0
 
 
 class _Accumulator:
@@ -85,6 +88,12 @@ class MicroBatcher:
         self._stats_lock = threading.Lock()
         self._batch_hist: dict[int, int] = {}
         self._n_submits = 0
+        # per-request latency decomposition (soak-tail attribution,
+        # VERDICT r3 #10): queue wait (submit -> kernel launch) vs
+        # device execute (launch -> results ready). Bounded ring so a
+        # long-lived server cannot grow it unboundedly.
+        self._wait_ms: deque = deque(maxlen=65536)
+        self._exec_ms: deque = deque(maxlen=65536)
         # weak-keyed by the DeviceIndex so accumulators die with their
         # index (re-ingestion replaces DeviceIndex objects; an id()-keyed
         # dict would leak one accumulator per replaced index and could
@@ -117,7 +126,9 @@ class MicroBatcher:
         n_matched, overflow, rows) for this one query — one row of the
         batched QueryResults."""
         acc = self._accum(dindex, (window_cap, record_cap))
-        me = _Pending(spec=spec, event=threading.Event())
+        me = _Pending(
+            spec=spec, event=threading.Event(), t_submit=time.perf_counter()
+        )
         with self._stats_lock:
             self._n_submits += 1
 
@@ -183,6 +194,31 @@ class MicroBatcher:
                     p.event.set()
             raise
 
+    def timing_summary(self) -> dict:
+        """Percentiles of the per-request decomposition: queue_wait_ms
+        (submit -> kernel launch; server-side queueing behind in-flight
+        launches) and exec_ms (launch -> results; the device dispatch
+        incl. any tunnel RTT). client_latency ~= queue_wait + exec +
+        HTTP/materialisation overhead — the soak harness reports all
+        three so tails are attributable."""
+        import numpy as np
+
+        def pct(xs):
+            if not xs:
+                return {}
+            a = np.asarray(xs)
+            return {
+                "p50": round(float(np.percentile(a, 50)), 2),
+                "p95": round(float(np.percentile(a, 95)), 2),
+                "p99": round(float(np.percentile(a, 99)), 2),
+            }
+
+        with self._stats_lock:
+            return {
+                "queue_wait_ms": pct(list(self._wait_ms)),
+                "exec_ms": pct(list(self._exec_ms)),
+            }
+
     def occupancy(self) -> dict:
         """{'submits': N, 'launches': M, 'mean_batch': x, 'histogram':
         {size: count}} — cumulative since construction."""
@@ -199,10 +235,13 @@ class MicroBatcher:
 
     def _execute(self, batch, dindex, window_cap, record_cap):
         specs = [p.spec for p in batch]
+        t_launch = time.perf_counter()
         with self._stats_lock:
             self._batch_hist[len(specs)] = (
                 self._batch_hist.get(len(specs), 0) + 1
             )
+            for p in batch:
+                self._wait_ms.append((t_launch - p.t_submit) * 1e3)
         try:
             with span("serving.microbatch") as sp:
                 enc = encode_queries(specs)
@@ -220,6 +259,11 @@ class MicroBatcher:
                 p.error = e
                 p.event.set()
             return
+        t_done = time.perf_counter()
+        with self._stats_lock:
+            exec_ms = (t_done - t_launch) * 1e3
+            for _ in batch:
+                self._exec_ms.append(exec_ms)
         for i, p in enumerate(batch):
             p.result = QueryResults(
                 exists=res.exists[i : i + 1],

@@ -314,3 +314,131 @@ def test_dense_fetch_walk_matches_generic_shape(tmp_path):
         "individuals",
     ) is None
     assert store._dense_single_term(dense + ontology_f, "individuals") is None
+
+
+def test_count_fast_path_matches_generic():
+    """The term_counts fast path (r4: precomputed cardinalities +
+    covering-index COUNT DISTINCT) must agree with the generic
+    id-IN-subquery count on randomized corpora, for singleton and
+    descendant-expanded filters, before and after re-upserts."""
+    import random
+
+    from sbeacon_tpu.metadata.ontology import OntologyStore
+    from sbeacon_tpu.metadata.store import MetadataStore
+
+    rng = random.Random(71)
+    onto = OntologyStore()
+    # HP:10 -> {HP:20, HP:30}; HP:20 -> {HP:21, HP:22}
+    onto.register_edges(
+        [("HP:20", "HP:10"), ("HP:30", "HP:10"),
+         ("HP:21", "HP:20"), ("HP:22", "HP:20")]
+    )
+    store = MetadataStore(ontology=onto)
+    terms = ["HP:20", "HP:30", "HP:21", "HP:22", "HP:99"]
+    store.upsert("datasets", [{"id": "d0", "name": "d"}])
+    docs = []
+    for i in range(400):
+        t = rng.choice(terms)
+        docs.append(
+            {
+                "id": f"i{i}",
+                "datasetId": "d0",
+                "sex": {"id": t, "label": t},
+            }
+        )
+    store.upsert("individuals", docs)
+    store.rebuild_indexes()
+
+    def generic_count(kind, filters):
+        where, params = store._compile(filters, kind)
+        return int(
+            store._read(f"SELECT COUNT(*) FROM {kind} {where}", params)[0][0]
+        )
+
+    nonzero = 0
+    for fid in ["HP:20", "HP:30", "HP:10", "HP:99", "HP:21", "HP:77"]:
+        for desc in (True, False):
+            filters = [{"id": fid, "includeDescendantTerms": desc}]
+            fast = store.count("individuals", filters)
+            want = generic_count("individuals", filters)
+            assert fast == want, (fid, desc, fast, want)
+            nonzero += fast > 0
+    assert nonzero >= 6  # the battery must actually exercise hits
+
+    # stale-consistency: upserts leave term_counts AND terms_index
+    # equally stale — the two paths must still agree
+    store.upsert(
+        "individuals",
+        [{"id": "extra", "datasetId": "d0", "sex": {"id": "HP:30"}}],
+    )
+    for fid in ["HP:30", "HP:10"]:
+        filters = [{"id": fid}]
+        assert store.count("individuals", filters) == generic_count(
+            "individuals", filters
+        ), fid
+    # after rebuild the new row is visible through both
+    store.rebuild_indexes()
+    filters = [{"id": "HP:30"}]
+    got = store.count("individuals", filters)
+    assert got == generic_count("individuals", filters)
+    assert got > 0
+
+    # non-high similarity tiers bypass the precompute (plan-B fallback)
+    for sim in ("medium", "low"):
+        filters = [{"id": "HP:21", "similarity": sim}]
+        assert store.count("individuals", filters) == generic_count(
+            "individuals", filters
+        ), sim
+    # unknown term: zero through both paths
+    filters = [{"id": "HP:404404"}]
+    assert store.count("individuals", filters) == generic_count(
+        "individuals", filters
+    ) == 0
+
+
+def test_count_fast_path_respects_deletes():
+    """delete() must immediately disable the precomputed-cardinality
+    lookup (the generic plan excludes deleted entities at once; the
+    cached numbers cannot) and the fallback plan must agree with the
+    generic count."""
+    import random
+
+    from sbeacon_tpu.metadata.ontology import OntologyStore
+    from sbeacon_tpu.metadata.store import MetadataStore
+
+    rng = random.Random(73)
+    onto = OntologyStore()
+    onto.register_edges([("HP:20", "HP:10"), ("HP:21", "HP:20")])
+    store = MetadataStore(ontology=onto)
+    store.upsert("datasets", [{"id": "d0", "name": "d"}])
+    store.upsert(
+        "individuals",
+        [
+            {
+                "id": f"i{k}",
+                "datasetId": "d0",
+                "sex": {"id": rng.choice(["HP:20", "HP:21"]), "label": "x"},
+            }
+            for k in range(100)
+        ],
+    )
+    store.rebuild_indexes()
+
+    def generic(filters):
+        where, params = store._compile(filters, "individuals")
+        return int(
+            store._read(
+                f"SELECT COUNT(*) FROM individuals {where}", params
+            )[0][0]
+        )
+
+    before = store.count("individuals", [{"id": "HP:10"}])
+    assert before == generic([{"id": "HP:10"}]) == 100
+    store.delete("individuals", "i7")
+    for fid in ["HP:10", "HP:20", "HP:21"]:
+        filters = [{"id": fid}]
+        got = store.count("individuals", filters)
+        assert got == generic(filters), (fid, got)
+    # rebuild restores the O(1) lookup
+    store.rebuild_indexes()
+    assert store.count("individuals", [{"id": "HP:10"}]) == 99
