@@ -902,7 +902,15 @@ def build_index_from_text(
     n_samples = len(sample_names)
     gt_words = (n_samples + 31) // 32 if n_samples else 0
 
-    tk = native.tokenize(text, n_samples)
+    # fused single-pass tokenizer+planes when available (r4 ingest hot
+    # path: one scan instead of tokenize + gt_planes re-parse); the
+    # unfused pair stays as fallback and as the parity cross-check
+    fused = True
+    try:
+        tk = native.tokenize_planes(text, n_samples, gt_words)
+    except native.NativeUnavailable:
+        fused = False
+        tk = native.tokenize(text, n_samples)
     n_rec = int(tk["n_rec"])
     text_np = np.frombuffer(text or b"\0", dtype=np.uint8)
 
@@ -1077,10 +1085,45 @@ def build_index_from_text(
     alt_blob, alt_off = ragged(tk["alt_off"][flat_alt_idx].astype(np.int64),
                                alt_len_row)
 
-    # -- genotype planes (native, one pass over the tokenizer's cells) -----
+    # -- genotype planes -----------------------------------------------
     gt_bits = gt_bits2 = tok_bits1 = tok_bits2 = None
     gt_over = tok_over = None
-    if gt_words:
+    if gt_words and fused:
+        # planes came out of the same native pass in TEXT order; one
+        # gather reorders them to final row order, and the overflow
+        # triples remap through the same permutation
+        gt_bits = tk["g1"][flat_alt_idx]
+        gt_bits2 = tk["g2"][flat_alt_idx]
+        tok_bits1 = tk["t1"][rec_row]
+        tok_bits2 = tk["t2"][rec_row]
+        inv = np.full(int(tk["n_alt"]), -1, np.int64)
+        inv[flat_alt_idx] = np.arange(n, dtype=np.int64)
+        g_o = tk["gt_over"]
+        if len(g_o):
+            rows_m = inv[g_o[:, 0]]
+            keep = rows_m >= 0
+            gt_over = np.stack(
+                [rows_m[keep], g_o[keep, 1], g_o[keep, 2]], axis=1
+            )
+        else:
+            gt_over = np.zeros((0, 3), np.int64)
+        t_o = tk["tok_over"]
+        trip = []
+        if len(t_o):
+            # replicate each (rec, sample, ntok) onto that record's rows
+            order2 = np.argsort(rec_row, kind="stable")
+            sorted_rec = rec_row[order2]
+            for r, smp, ntok in t_o.tolist():
+                lo = int(np.searchsorted(sorted_rec, r, side="left"))
+                hi = int(np.searchsorted(sorted_rec, r, side="right"))
+                for row in order2[lo:hi].tolist():
+                    trip.append((row, smp, ntok))
+        tok_over = (
+            np.asarray(trip, np.int64).reshape(-1, 3)
+            if trip
+            else np.zeros((0, 3), np.int64)
+        )
+    elif gt_words:
         gt_over = np.zeros((0, 3), np.int64)
         tok_over = np.zeros((0, 3), np.int64)
         if n and len(tk["gt_blob"]):

@@ -176,3 +176,54 @@ def test_overflowing_int_fields_treated_absent():
     fast = build_index_from_text(text, sample_names=["A"])
     assert int(fast.cols["an"][0]) == 2  # token count of '0|1'
     assert not (fast.cols["flags"][0] & 1024)  # AN_INFO not set
+
+
+def test_fused_matches_unfused_tokenizer(monkeypatch):
+    """The fused tokenize+planes pass and the two-pass fallback must
+    build bit-identical shards (the fallback is also what runs on a
+    stale library, so it must stay correct)."""
+    import random
+
+    import numpy as np
+
+    from sbeacon_tpu import native
+    from sbeacon_tpu.index import columnar
+    from sbeacon_tpu.testing import random_records
+
+    rng = random.Random(31)
+    recs = random_records(
+        rng, chrom="5", n=300, n_samples=7,
+        p_multiallelic=0.3, p_symbolic=0.1, p_no_acan=0.4,
+    )
+    for rec in recs[::9]:  # ploidy>2 overflow entries
+        rec.genotypes[rng.randrange(7)] = "0/1/1/1"
+        rec.ac = None
+        rec.an = None
+    names = [f"S{i}" for i in range(7)]
+    text = _text_of(recs, names)
+
+    fused = columnar.build_index_from_text(
+        text, dataset_id="f", sample_names=names
+    )
+
+    def unavailable(*a, **k):
+        raise native.NativeUnavailable("forced fallback")
+
+    monkeypatch.setattr(native, "tokenize_planes", unavailable)
+    unfused = columnar.build_index_from_text(
+        text, dataset_id="f", sample_names=names
+    )
+
+    assert fused.n_rows == unfused.n_rows
+    for k in fused.cols:
+        assert np.array_equal(fused.cols[k], unfused.cols[k]), k
+    for attr in ("gt_bits", "gt_bits2", "tok_bits1", "tok_bits2"):
+        assert np.array_equal(
+            getattr(fused, attr), getattr(unfused, attr)
+        ), attr
+    # overflow triples: same SET (emission order may differ)
+    for attr in ("gt_overflow", "tok_overflow"):
+        a = {tuple(r) for r in getattr(fused, attr).tolist()}
+        b = {tuple(r) for r in getattr(unfused, attr).tolist()}
+        assert a == b, attr
+    assert len({tuple(r) for r in fused.gt_overflow.tolist()}) > 0
