@@ -1,0 +1,30 @@
+import numpy as np
+from sbeacon_tpu import native
+from sbeacon_tpu.index import columnar
+
+names = ["S0","S1"]
+# 'weird' chrom -> code 0 -> record dropped; has overflow that must be filtered
+body = "\n".join([
+    "weird_chrom\t50\t.\tA\tT\t.\t.\t.\tGT\t1/1/1\t0|1",
+    "2\t60\t.\tA\tT,G\t.\t.\t.\tGT\t2/2/2\t1|1",
+    "weird2\t70\t.\tA\tT\t.\t.\t.\tGT\t1/1/1/1\t.",
+    "1\t10\t.\tA\tT\t.\t.\t.\tGT\t0/1/1/1\t1",   # out-of-order chrom -> row sort permutes
+]) + "\n"
+text = body.encode()
+fused = columnar.build_index_from_text(text, dataset_id="d", sample_names=names)
+real = native.tokenize_planes
+native.tokenize_planes = lambda *a, **k: (_ for _ in ()).throw(native.NativeUnavailable("x"))
+try:
+    unfused = columnar.build_index_from_text(text, dataset_id="d", sample_names=names)
+finally:
+    native.tokenize_planes = real
+ok = True
+for k in fused.cols:
+    ok &= np.array_equal(fused.cols[k], unfused.cols[k])
+for attr in ("gt_bits","gt_bits2","tok_bits1","tok_bits2"):
+    ok &= np.array_equal(getattr(fused, attr), getattr(unfused, attr))
+for attr in ("gt_overflow","tok_overflow"):
+    a = sorted(map(tuple, getattr(fused, attr).tolist()))
+    b = sorted(map(tuple, getattr(unfused, attr).tolist()))
+    if a != b: print("MISMATCH", attr, a, b); ok = False
+print("OK" if ok else "FAILED", fused.meta["dropped_records"], fused.gt_overflow.tolist(), fused.tok_overflow.tolist())

@@ -1,0 +1,43 @@
+import numpy as np
+from sbeacon_tpu import native
+from sbeacon_tpu.index import columnar
+
+names = ["S0","S1","S2","S3"]
+# edge lines: fewer sample cols than names, more cols than names, trailing tab,
+# ploidy-20 (spill >16 tokens), GT piece with multi-digit allele, empty GT, FORMAT without GT
+body = "\n".join([
+    "#h",
+    "1\t100\t.\tA\tT,G\t.\t.\tAC=1,2;AN=4\tGT\t0|1\t1/2",                      # fewer cols (2 of 4)
+    "1\t101\t.\tA\tT\t.\t.\t.\tGT\t0|1\t1|1\t0/0\t.\t1|0",                     # 5 cols > 4 names
+    "1\t102\t.\tA\tT\t.\t.\t.\tGT\t" + "/".join(["1"]*20) + "\t0|1\t\t.",      # ploidy 20 spill + empty col
+    "1\t103\t.\tA\tT,G,C\t.\t.\t.\tGT:DP\t2:9\t10|2:3\t0/1/1/2:.\t2|2",        # gt multi-digit, quad
+    "1\t104\t.\tA\tT\t.\t.\t.\tDP\t5\t6\t7\t8",                                # no GT in FORMAT
+    "1\t105\t.\tA\tT\t.\t.\tAC=;AN=x\tGT\t0|1\t1|1\t1\t",                      # bad AC/AN, trailing tab
+]) + "\n"
+text = body.encode()
+
+fused = columnar.build_index_from_text(text, dataset_id="d", sample_names=names)
+
+real = native.tokenize_planes
+def unavailable(*a, **k): raise native.NativeUnavailable("forced")
+native.tokenize_planes = unavailable
+try:
+    unfused = columnar.build_index_from_text(text, dataset_id="d", sample_names=names)
+finally:
+    native.tokenize_planes = real
+
+ok = True
+for k in fused.cols:
+    if not np.array_equal(fused.cols[k], unfused.cols[k]):
+        print("MISMATCH col", k, fused.cols[k], unfused.cols[k]); ok = False
+for attr in ("gt_bits","gt_bits2","tok_bits1","tok_bits2"):
+    a, b = getattr(fused, attr), getattr(unfused, attr)
+    if not np.array_equal(a, b):
+        print("MISMATCH", attr); print(a); print(b); ok = False
+for attr in ("gt_overflow","tok_overflow"):
+    a = sorted(map(tuple, getattr(fused, attr).tolist()))
+    b = sorted(map(tuple, getattr(unfused, attr).tolist()))
+    if a != b:
+        print("MISMATCH", attr, a, b); ok = False
+print("OK" if ok else "FAILED", "rows:", fused.n_rows,
+      "gt_over:", fused.gt_overflow.tolist(), "tok_over:", fused.tok_overflow.tolist())

@@ -1,0 +1,186 @@
+"""Kernel layout experiments for VERDICT r3 #1 (timing only, no parity).
+
+Variants at 2e7-row shape, uniform + clustered query distributions:
+  A. baseline: C=2 tile gather ([n_tiles, 8, 128], 8 KB/query)
+  B. C=1 tile gather (4 KB/query, ignores straddle for timing)
+  C. interleaved lines: [n_lines, 128], line = 16 rows x 8 words;
+     gather L=2 lines/query (1 KB/query)
+  D. interleaved lines, L=3 (1.5 KB/query)
+  E. gather-only (no predicate stack) for A and C — decomposition
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_ROWS = 20_000_000
+T = 128
+ROWS_PER_LINE = 16
+NSLOTS = 2048
+ITERS = 192
+
+print("devices:", jax.devices(), file=sys.stderr)
+
+rng = np.random.default_rng(7)
+
+n_tiles = N_ROWS // T + 1 + 17
+tiles_host = rng.integers(0, 2**31 - 1, size=(n_tiles, 8, T), dtype=np.int32)
+# lines layout: same bytes, line l = rows [l*16,(l+1)*16) x 8 words
+n_lines = N_ROWS // ROWS_PER_LINE + 1 + 17
+lines_host = rng.integers(0, 2**31 - 1, size=(n_lines, 128), dtype=np.int32)
+
+t0 = time.perf_counter()
+tiles = jax.device_put(tiles_host)
+lines = jax.device_put(lines_host)
+np.asarray(jax.device_get(tiles[0, 0, :1]))
+np.asarray(jax.device_get(lines[0, :1]))
+print(f"upload {time.perf_counter()-t0:.1f}s", file=sys.stderr)
+
+
+def mk_queries(clustered: bool):
+    if clustered:
+        # config8-style: hot 1% region
+        centers = rng.integers(0, N_ROWS // 100, size=NSLOTS)
+        lo = centers + N_ROWS // 3
+    else:
+        lo = rng.integers(0, N_ROWS - 256, size=NSLOTS)
+    width = rng.integers(1, 5, size=NSLOTS)
+    hi = lo + width
+    q8 = rng.integers(0, 2**31 - 1, size=(NSLOTS, 8), dtype=np.int32)
+    q8[:, 0] = lo
+    q8[:, 1] = hi
+    return lo.astype(np.int64), hi.astype(np.int64), q8
+
+
+def predicates(win, qarr, gidx):
+    """Representative predicate stack (same op count/shape as the real
+    kernel, approximated: ~30 elementwise ops + 2 reductions + scan)."""
+    row = lambda r: win[:, r, :]
+    q = lambda f: qarr[:, f : f + 1]
+    b2i = lambda c: jnp.where(c, jnp.int32(1), jnp.int32(0))
+    lo = q(0)
+    hi = q(1)
+    valid = b2i(gidx >= lo) & b2i(gidx < hi)
+    rec_end = row(1)
+    end_ok = b2i(q(2) <= rec_end) & b2i(rec_end <= q(3))
+    lens = row(4)
+    alt_len = lens & 0xFFFF
+    ref_len = (lens >> 16) & 0x1FFF
+    ref_ok = b2i(row(2) == q(4)) & b2i(ref_len == (q(6) & 0x1FFF))
+    len_ok = b2i(alt_len <= (q(7) & 0xFFFF))
+    flags = row(5)
+    f = lambda bit: b2i((flags & bit) != 0)
+    sym = f(1 << 5)
+    type_ok = (sym & f(1 << 6)) | ((1 - sym) & b2i(alt_len < ref_len))
+    alt_ok = b2i(row(3) == q(5)) | type_ok
+    m_i = valid & end_ok & ref_ok & len_ok & alt_ok
+    ac = row(6)
+    call_count = jnp.sum(m_i * ac, axis=1, keepdims=True)
+    n_matched = jnp.sum(m_i, axis=1, keepdims=True)
+    seg_begin = (1 - f(1 << 26)) | b2i(gidx == lo)
+    cs = jnp.cumsum(m_i, axis=1)
+    before = cs - m_i
+    seg_base = jax.lax.cummax(
+        jnp.where(seg_begin != 0, before, jnp.int32(-1)), axis=1
+    )
+    first_match = m_i & b2i(before == seg_base)
+    all_alleles = jnp.sum(first_match * row(7), axis=1, keepdims=True)
+    return jnp.concatenate(
+        [call_count, n_matched, all_alleles], axis=1
+    )
+
+
+@partial(jax.jit, static_argnames=("C", "gather_only"))
+def batch_tiles(tiles, tile_ids, qarr, *, C, gather_only=False):
+    gat = tiles[tile_ids[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]]
+    span = C * T
+    win = jnp.transpose(gat, (0, 2, 1, 3)).reshape(-1, 8, span)
+    if gather_only:
+        return jnp.sum(win, axis=(1, 2), keepdims=False)[:, None]
+    gidx = tile_ids[:, None] * T + jax.lax.broadcasted_iota(
+        jnp.int32, (1, span), 1
+    )
+    return predicates(win, qarr, gidx)
+
+
+@partial(jax.jit, static_argnames=("L", "gather_only"))
+def batch_lines(lines, line_ids, qarr, *, L, gather_only=False):
+    gat = lines[line_ids[:, None] + jnp.arange(L, dtype=jnp.int32)[None, :]]
+    # [B, L, 128] -> [B, L, 16, 8] -> [B, 8, L*16]
+    span = L * ROWS_PER_LINE
+    win = jnp.transpose(
+        gat.reshape(-1, L, ROWS_PER_LINE, 8), (0, 3, 1, 2)
+    ).reshape(-1, 8, span)
+    if gather_only:
+        return jnp.sum(win, axis=(1, 2))[:, None]
+    gidx = line_ids[:, None] * ROWS_PER_LINE + jax.lax.broadcasted_iota(
+        jnp.int32, (1, span), 1
+    )
+    return predicates(win, qarr, gidx)
+
+
+@partial(jax.jit, static_argnames=("k", "C", "kind", "gather_only"))
+def probe(arr, ids, qarr, *, k, C, kind, gather_only):
+    nmax = jnp.int32(arr.shape[0] - 20)
+
+    def body(carry, _):
+        if kind == "tiles":
+            agg = batch_tiles(arr, carry, qarr, C=C, gather_only=gather_only)
+        else:
+            agg = batch_lines(arr, carry, qarr, L=C, gather_only=gather_only)
+        return (carry + agg[0, 0]) % nmax, agg[0, 0]
+
+    _, outs = jax.lax.scan(body, ids, None, length=k)
+    return jnp.sum(outs)
+
+
+def timed(arr, ids, qarr, *, k, C, kind, gather_only, reps=3):
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        np.asarray(
+            jax.device_get(
+                probe(arr, ids, qarr, k=k, C=C, kind=kind, gather_only=gather_only)
+            )
+        )
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def run(name, arr, ids_np, qarr_np, *, C, kind, gather_only=False):
+    ids = jnp.asarray(ids_np)
+    qarr = jnp.asarray(qarr_np)
+    k1 = 8
+    k2 = k1 + ITERS
+    timed(arr, ids, qarr, k=k1, C=C, kind=kind, gather_only=gather_only, reps=1)
+    timed(arr, ids, qarr, k=k2, C=C, kind=kind, gather_only=gather_only, reps=1)
+    d = timed(arr, ids, qarr, k=k2, C=C, kind=kind, gather_only=gather_only) - timed(
+        arr, ids, qarr, k=k1, C=C, kind=kind, gather_only=gather_only
+    )
+    per = d / ITERS
+    if kind == "tiles":
+        byts = NSLOTS * C * 8 * T * 4
+    else:
+        byts = NSLOTS * C * 128 * 4
+    print(
+        f"{name:28s} per_batch={per*1e6:8.1f}us qps={NSLOTS/per/1e6:7.2f}M "
+        f"bytes/q={byts//NSLOTS:6d} eff_gbps={byts/per/1e9:6.1f}"
+    )
+
+
+for dist in (False, True):
+    tag = "clustered" if dist else "uniform"
+    lo, hi, q8 = mk_queries(dist)
+    tile_ids = (lo // T).astype(np.int32)
+    line_ids = (lo // ROWS_PER_LINE).astype(np.int32)
+    print(f"--- {tag} ---")
+    run(f"A tiles C=2 {tag}", tiles, tile_ids, q8, C=2, kind="tiles")
+    run(f"B tiles C=1 {tag}", tiles, tile_ids, q8, C=1, kind="tiles")
+    run(f"C lines L=2 {tag}", lines, line_ids, q8, C=2, kind="lines")
+    run(f"D lines L=3 {tag}", lines, line_ids, q8, C=3, kind="lines")
+    run(f"E gather-only tiles C=2 {tag}", tiles, tile_ids, q8, C=2, kind="tiles", gather_only=True)
+    run(f"F gather-only lines L=2 {tag}", lines, line_ids, q8, C=2, kind="lines", gather_only=True)

@@ -1,0 +1,48 @@
+"""Real-kernel probe with the C=1 tier: headline 2e7 uniform + config8 skew."""
+import sys
+import time
+
+from sbeacon_tpu.ops.kernel import encode_queries
+from sbeacon_tpu.ops.scatter_kernel import (
+    ScatterDeviceIndex,
+    device_time_probe,
+    run_queries_scattered,
+)
+from sbeacon_tpu.testing import synthetic_shard
+
+sys.path.insert(0, ".")
+from bench import _point_specs  # noqa: E402
+
+import numpy as np  # noqa: E402
+
+
+def probe(rows, model, n_q, window_cap, label, seed):
+    t0 = time.perf_counter()
+    shard = synthetic_shard(
+        rows, seed=seed, dataset_id=f"x-{model}", position_model=model
+    )
+    print(f"{label}: shard built {time.perf_counter()-t0:.0f}s", file=sys.stderr)
+    t0 = time.perf_counter()
+    sindex = ScatterDeviceIndex(shard)
+    sindex.tiles.block_until_ready()
+    print(f"{label}: uploaded {time.perf_counter()-t0:.0f}s", file=sys.stderr)
+    specs = _point_specs(shard, n_q, seed=9)
+    enc = encode_queries(specs)
+    res = run_queries_scattered(
+        sindex, enc, window_cap=window_cap, record_cap=64, with_rows=False
+    )
+    per, gathered = device_time_probe(
+        sindex, enc, window_cap=window_cap, iters=256
+    )
+    print(
+        f"{label}: per_2048={per*1e6:.1f}us qps={2048/per/1e6:.2f}M "
+        f"gb/s={gathered/per/1e9:.1f} hits={int(res.exists.sum())} "
+        f"overflow={int(res.overflow.sum())}"
+    )
+    return 2048 / per
+
+
+u = probe(20_000_000, "uniform", 10_000, 128, "headline-2e7", 11)
+u8 = probe(5_000_000, "uniform", 4_000, 512, "config8-uniform", 77)
+c8 = probe(5_000_000, "clustered", 4_000, 512, "config8-clustered", 77)
+print(f"clustered_penalty={u8/c8:.2f}x")

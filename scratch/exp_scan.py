@@ -1,0 +1,123 @@
+"""Variants on the C=1 batch: scan vs arity-shift first-match, nslots."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+N_ROWS = 20_000_000
+T = 128
+ITERS = 256
+
+rng = np.random.default_rng(7)
+n_tiles = N_ROWS // T + 1 + 17
+tiles = jax.device_put(
+    rng.integers(0, 2**31 - 1, size=(n_tiles, 8, T), dtype=np.int32)
+)
+np.asarray(jax.device_get(tiles[0, 0, :1]))
+print("uploaded", file=sys.stderr)
+
+
+def predicates(win, qarr, gidx, *, scan_mode, K=4):
+    row = lambda r: win[:, r, :]
+    q = lambda f: qarr[:, f : f + 1]
+    b2i = lambda c: jnp.where(c, jnp.int32(1), jnp.int32(0))
+    lo = q(0)
+    hi = q(1)
+    valid = b2i(gidx >= lo) & b2i(gidx < hi)
+    rec_end = row(1)
+    end_ok = b2i(q(2) <= rec_end) & b2i(rec_end <= q(3))
+    lens = row(4)
+    alt_len = lens & 0xFFFF
+    ref_len = (lens >> 16) & 0x1FFF
+    ref_ok = b2i(row(2) == q(4)) & b2i(ref_len == (q(6) & 0x1FFF))
+    len_ok = b2i(alt_len <= (q(7) & 0xFFFF))
+    flags = row(5)
+    f = lambda bit: b2i((flags & bit) != 0)
+    sym = f(1 << 5)
+    type_ok = (sym & f(1 << 6)) | ((1 - sym) & b2i(alt_len < ref_len))
+    alt_ok = b2i(row(3) == q(5)) | type_ok
+    m_i = valid & end_ok & ref_ok & len_ok & alt_ok
+    ac = row(6)
+    call_count = jnp.sum(m_i * ac, axis=1, keepdims=True)
+    n_matched = jnp.sum(m_i, axis=1, keepdims=True)
+    same = f(1 << 26)
+    if scan_mode == "scan":
+        seg_begin = (1 - same) | b2i(gidx == lo)
+        cs = jnp.cumsum(m_i, axis=1)
+        before = cs - m_i
+        seg_base = jax.lax.cummax(
+            jnp.where(seg_begin != 0, before, jnp.int32(-1)), axis=1
+        )
+        first_match = m_i & b2i(before == seg_base)
+    else:  # arity shifts
+        shift = lambda x, j: jnp.pad(x, ((0, 0), (j, 0)))[:, : x.shape[1]]
+        link = same
+        before_m = jnp.zeros_like(m_i)
+        for j in range(1, K):
+            before_m = before_m | (link & shift(m_i, j))
+            if j + 1 < K:
+                link = link & shift(same, j)
+        first_match = m_i & (1 - before_m)
+    all_alleles = jnp.sum(first_match * row(7), axis=1, keepdims=True)
+    return jnp.concatenate([call_count, n_matched, all_alleles], axis=1)
+
+
+@partial(jax.jit, static_argnames=("scan_mode", "K"))
+def batch(tiles, tile_ids, qarr, *, scan_mode, K=4):
+    gat = tiles[tile_ids[:, None] + jnp.arange(1, dtype=jnp.int32)[None, :]]
+    win = jnp.transpose(gat, (0, 2, 1, 3)).reshape(-1, 8, T)
+    gidx = tile_ids[:, None] * T + jax.lax.broadcasted_iota(
+        jnp.int32, (1, T), 1
+    )
+    return predicates(win, qarr, gidx, scan_mode=scan_mode, K=K)
+
+
+@partial(jax.jit, static_argnames=("k", "scan_mode", "K"))
+def probe(arr, ids, qarr, *, k, scan_mode, K=4):
+    nmax = jnp.int32(arr.shape[0] - 20)
+
+    def body(carry, _):
+        agg = batch(arr, carry, qarr, scan_mode=scan_mode, K=K)
+        return (carry + agg[0, 0]) % nmax, agg[0, 0]
+
+    _, outs = jax.lax.scan(body, ids, None, length=k)
+    return jnp.sum(outs)
+
+
+def run(name, nslots, scan_mode, K=4):
+    lo = rng.integers(0, N_ROWS - 256, size=nslots)
+    q8 = rng.integers(0, 2**31 - 1, size=(nslots, 8), dtype=np.int32)
+    q8[:, 0] = lo
+    q8[:, 1] = lo + rng.integers(1, 5, size=nslots)
+    ids = jnp.asarray((lo // T).astype(np.int32))
+    qarr = jnp.asarray(q8)
+
+    def timed(k, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(
+                jax.device_get(probe(tiles, ids, qarr, k=k, scan_mode=scan_mode, K=K))
+            )
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    timed(8, reps=1)
+    timed(8 + ITERS, reps=1)
+    d = timed(8 + ITERS) - timed(8)
+    per = d / ITERS
+    print(
+        f"{name:34s} per_slot={per/nslots*1e9:6.1f}ns qps={nslots/per/1e6:7.2f}M"
+    )
+
+
+run("scan nslots=2048", 2048, "scan")
+run("shiftK4 nslots=2048", 2048, "shift", 4)
+run("shiftK8 nslots=2048", 2048, "shift", 8)
+run("scan nslots=4096", 4096, "scan")
+run("shiftK4 nslots=4096", 4096, "shift", 4)
+run("scan nslots=8192", 8192, "scan")
+run("shiftK4 nslots=8192", 8192, "shift", 4)

@@ -1,0 +1,152 @@
+"""Real _scatter_batch C=1 vs MODE_EXACT-specialized variant (fabricated data)."""
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+from sbeacon_tpu.ops import scatter_kernel as sk
+from sbeacon_tpu.ops.kernel import MODE_EXACT
+from sbeacon_tpu.ops.query_pack import (
+    Q_ALT_HASH,
+    Q_END_MAX,
+    Q_END_MIN,
+    Q_HI,
+    Q_LENS,
+    Q_LO,
+    Q_META,
+    Q_REF_HASH,
+)
+
+N_ROWS = 20_000_000
+T = 128
+NSLOTS = 2048
+ITERS = 256
+
+rng = np.random.default_rng(7)
+n_tiles = N_ROWS // T + 1 + 17
+tiles = jax.device_put(
+    rng.integers(0, 2**31 - 1, size=(n_tiles, 8, T), dtype=np.int32)
+)
+np.asarray(jax.device_get(tiles[0, 0, :1]))
+print("uploaded", file=sys.stderr)
+
+lo = rng.integers(0, N_ROWS - 256, size=NSLOTS).astype(np.int64)
+hi = lo + rng.integers(1, 5, size=NSLOTS)
+q8 = np.zeros((NSLOTS, 8), np.int64)
+q8[:, Q_LO] = lo
+q8[:, Q_HI] = hi
+q8[:, Q_END_MIN] = 0
+q8[:, Q_END_MAX] = 2**30
+q8[:, Q_REF_HASH] = rng.integers(0, 2**31, NSLOTS)
+q8[:, Q_ALT_HASH] = rng.integers(0, 2**31, NSLOTS)
+q8[:, Q_META] = (MODE_EXACT << 1) | (1 << 6)
+q8[:, Q_LENS] = 1 | (0xFFFF << 16)
+q8 = (q8 & 0xFFFFFFFF).astype(np.uint32).view(np.int32)
+tile_ids = (lo // T).astype(np.int32)
+
+
+def chain_probe(fn_probe, label):
+    td = jnp.asarray(tile_ids)
+    qd = jnp.asarray(q8)
+
+    def timed(k, reps=3):
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            np.asarray(jax.device_get(fn_probe(tiles, td, qd, k)))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    timed(8, reps=1)
+    timed(8 + ITERS, reps=1)
+    d = timed(8 + ITERS) - timed(8)
+    per = d / ITERS
+    print(f"{label:30s} per_2048={per*1e6:6.1f}us qps={NSLOTS/per/1e6:7.2f}M")
+
+
+chain_probe(
+    lambda t, td, qd, k: sk._probe_rep(
+        t, td, qd, T=T, CAP=T, nslots=NSLOTS, k=k, C=1
+    ),
+    "real C=1 full",
+)
+
+
+# --- specialized exact-only batch ---
+@partial(jax.jit, static_argnames=("k",))
+def probe_exact(tiles, tile_ids, qarr, k):
+    nmax = jnp.int32(tiles.shape[0] - 20)
+
+    def body(carry, _):
+        agg = batch_exact(tiles, carry, qarr)
+        return (carry + agg[0, 1]) % nmax, agg[0, 1]
+
+    _, outs = jax.lax.scan(body, tile_ids, None, length=k)
+    return jnp.sum(outs)
+
+
+def batch_exact(tiles, tile_ids, qarr):
+    gat = tiles[tile_ids[:, None] + jnp.arange(1, dtype=jnp.int32)[None, :]]
+    win = jnp.transpose(gat, (0, 2, 1, 3)).reshape(-1, 8, T)
+    row = lambda r: win[:, r, :]
+    q = lambda f: qarr[:, f : f + 1]
+    b2i = lambda c: jnp.where(c, jnp.int32(1), jnp.int32(0))
+    lo = q(Q_LO)
+    hi = q(Q_HI)
+    gidx = tile_ids[:, None] * T + jax.lax.broadcasted_iota(
+        jnp.int32, (1, T), 1
+    )
+    valid = b2i(gidx >= lo) & b2i(gidx < hi)
+    rec_end = row(sk.P_REC_END)
+    end_ok = b2i(q(Q_END_MIN) <= rec_end) & b2i(rec_end <= q(Q_END_MAX))
+    meta = q(Q_META)
+    ref_len_q = (meta >> 6) & 0x1FFF
+    lens = row(sk.P_LENS)
+    alt_len = lens & 0xFFFF
+    ref_len = (lens >> 16) & 0x1FFF
+    ref_ok = b2i(row(sk.P_REF_HASH) == q(Q_REF_HASH)) & b2i(
+        ref_len == ref_len_q
+    )
+    alt_len_q = q(Q_LENS) & 0xFFFF
+    exact_ok = b2i(row(sk.P_ALT_HASH) == q(Q_ALT_HASH)) & b2i(
+        alt_len == alt_len_q
+    )
+    m_i = valid & end_ok & ref_ok & exact_ok
+    flags = row(sk.P_FLAGS)
+    f = lambda bit: b2i((flags & bit) != 0)
+    ac = row(sk.P_AC)
+    call_count = jnp.sum(m_i * ac, axis=1, keepdims=True)
+    n_variants = jnp.sum(m_i & b2i(ac != 0), axis=1, keepdims=True)
+    n_matched = jnp.sum(m_i, axis=1, keepdims=True)
+    seg_begin = (1 - f(sk.SAME_PREV)) | b2i(gidx == lo)
+    cs = jnp.cumsum(m_i, axis=1)
+    before = cs - m_i
+    seg_base = jax.lax.cummax(
+        jnp.where(seg_begin != 0, before, jnp.int32(-1)), axis=1
+    )
+    first_match = m_i & b2i(before == seg_base)
+    all_alleles = jnp.sum(first_match * row(sk.P_AN), axis=1, keepdims=True)
+    overflow = b2i(
+        jnp.sum(valid & f(sk.ROW_CLAMPED), axis=1, keepdims=True) > 0
+    )
+    zero = jnp.zeros_like(overflow)
+    return jnp.concatenate(
+        [
+            b2i(call_count > 0),
+            call_count,
+            n_variants,
+            all_alleles,
+            n_matched,
+            overflow,
+            zero,
+            zero,
+        ],
+        axis=1,
+    )
+
+
+chain_probe(probe_exact, "exact-specialized C=1")
