@@ -621,6 +621,9 @@ class VariantEngine:
         self._mesh_state = None
         self._mesh_dirty = True
         self.mesh_searches = 0
+        # key -> bytes reserved for an in-flight plane upload (counts
+        # against plane_hbm_budget_gb until the planes are published)
+        self._plane_reserved: dict = {}
 
     # -- index management ---------------------------------------------------
 
@@ -639,39 +642,59 @@ class VariantEngine:
         from .ops.plane_kernel import PlaneDeviceIndex
 
         budget = getattr(eng, "plane_hbm_budget_gb", 11.0) * 1e9
-        # CUMULATIVE gate: other shards' resident planes count against
-        # the budget. Re-ingestion must actually FREE the old set before
-        # the new upload (old+new coexisting would OOM a near-budget
-        # shard), so the key's entry is republished plane-less first —
-        # searches in that window take the host fallback, never a torn
-        # pairing.
+        est = PlaneDeviceIndex.estimate_hbm(shard)
+        # CUMULATIVE gate: other shards' resident planes AND in-flight
+        # uploads (reservations) count against the budget — reserve
+        # under the lock BEFORE uploading so two concurrent add_index
+        # calls cannot both pass the gate and jointly exceed it.
+        # Re-ingestion republishes the key plane-less first so searches
+        # in that window take the host fallback (the old PlaneDeviceIndex
+        # may still be referenced by an in-flight search or a mesh stack,
+        # so its HBM is only truly freed when those drop it — the budget
+        # is a watermark, not a hard cap, across that window).
+        token = object()  # unique per upload: same-key races each hold one
         with self._mesh_lock:
             prior = self._indexes.get(key)
             if prior is not None and prior[2] is not None:
                 self._indexes[key] = (prior[0], prior[1], None)
-            # drop the local reference too: it is the LAST holder of the
-            # old PlaneDeviceIndex, and its device arrays must actually
-            # free before the new upload claims HBM
             prior = None  # noqa: F841
+            # resident planes (the same key's were just republished
+            # plane-less above, so every remaining p counts) + EVERY
+            # in-flight reservation, including concurrent uploads of
+            # this same key — each holds its own token
             used = sum(
                 p.nbytes_hbm()
-                for k, (_s, _d, p) in self._indexes.items()
-                if p is not None and k != key
-            )
-        if used + PlaneDeviceIndex.estimate_hbm(shard) > budget:
+                for _k, (_s, _d, p) in self._indexes.items()
+                if p is not None
+            ) + sum(self._plane_reserved.values())
+            if used + est > budget:
+                over = True
+            else:
+                over = False
+                self._plane_reserved[token] = est
+        if over:
             logging.getLogger(__name__).info(
                 "genotype planes for %s exceed HBM budget "
-                "(%.1f GB resident); host-resident",
+                "(%.1f GB resident+reserved); host-resident",
                 key,
                 used / 1e9,
             )
             return None
         try:
-            return PlaneDeviceIndex(shard)
+            # reservation is released when the caller PUBLISHES the
+            # planes to _indexes (at which point they count as resident)
+            # or here on failure — never while the upload is in neither
+            # ledger. The token rides on the object so the publisher
+            # releases exactly this upload's reservation.
+            planes = PlaneDeviceIndex(shard)
+            planes._hbm_reservation = token
+            return planes
         except Exception:
             logging.getLogger(__name__).exception(
                 "plane upload failed for %s; host-resident", key
             )
+            with self._mesh_lock:
+                self._plane_reserved.pop(token, None)
             return None
 
     def add_index(self, shard: VariantIndexShard) -> None:
@@ -695,9 +718,20 @@ class VariantEngine:
         # publish + dirty-mark in one critical section: a concurrent
         # search must never pair the new shard with a mesh stack built
         # from the old one (_mesh_ready reads _indexes under this lock)
+        self._publish_index(key, shard, dindex, planes)
+
+    def _publish_index(self, key, shard, dindex, planes) -> None:
+        """Publish the (shard, dindex, planes) triple + dirty-mark + HBM
+        reservation release in ONE critical section: a concurrent search
+        must never pair the new shard with a stale mesh stack, and the
+        reservation must convert to residency atomically (never counted
+        twice, never counted nowhere)."""
         with self._mesh_lock:
             self._mesh_dirty = True
             self._indexes[key] = (shard, dindex, planes)
+            self._plane_reserved.pop(
+                getattr(planes, "_hbm_reservation", None), None
+            )
 
     _AUTO_PLANES = object()  # sentinel: build planes unless caller chose
 
@@ -713,9 +747,7 @@ class VariantEngine:
         key = (shard.meta.get("dataset_id", ""), shard.meta.get("vcf_location", ""))
         if planes is VariantEngine._AUTO_PLANES:
             planes = self._build_planes(key, shard, dindex)
-        with self._mesh_lock:
-            self._mesh_dirty = True
-            self._indexes[key] = (shard, dindex, planes)
+        self._publish_index(key, shard, dindex, planes)
 
     def close(self) -> None:
         """Release the scatter pool (same contract as

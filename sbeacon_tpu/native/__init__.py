@@ -193,6 +193,37 @@ def get_lib():
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.sbn_gt_planes.restype = ctypes.c_int64
+        if hasattr(lib, "sbn_tokenize_planes"):
+            # uint64 params MUST be declared: the ctypes default of
+            # c_int silently truncates len/n_samples/words >= 2^32
+            # (a >=2 GiB decompressed slice would mis-parse with no
+            # error on the fused hot path)
+            u8pp_ = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8))
+            u32pp_ = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint32))
+            u64pp_ = ctypes.POINTER(ctypes.POINTER(ctypes.c_uint64))
+            i64pp_ = ctypes.POINTER(ctypes.POINTER(ctypes.c_int64))
+            u64p_ = ctypes.POINTER(ctypes.c_uint64)
+            lib.sbn_tokenize_planes.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_uint64,      # len
+                ctypes.c_uint64,      # n_samples
+                ctypes.c_uint64,      # words
+                i64pp_,               # pos
+                u32pp_, u32pp_,       # chrom off/len
+                u32pp_, u32pp_,       # ref off/len
+                u32pp_, u32pp_,       # vt off/len
+                i64pp_, u8pp_, u8pp_,  # an, has_an, has_ac
+                i64pp_,               # tok_total
+                u32pp_, u32pp_, u64pp_,  # alt off/len/start
+                i64pp_,               # ac_gt
+                i64pp_, u64pp_,       # ac, ac_start
+                u32pp_, u32pp_,       # g1, g2
+                u32pp_, u32pp_,       # t1, t2
+                i64pp_, u64p_,        # gt_over, n_gt_over
+                i64pp_, u64p_,        # tok_over, n_tok_over
+                u64p_, u64p_, u64p_,  # n_rec, n_alt, n_ac
+            ]
+            lib.sbn_tokenize_planes.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -613,6 +644,7 @@ def tokenize_planes(text: bytes, n_samples: int, words: int) -> dict:
 
     planes = {"g1", "g2", "t1", "t2"}
     result = {}
+    finalized = set()  # plane keys whose buffer a finalizer now owns
     try:
         for k, v in outs.items():
             if not shapes[k]:
@@ -629,6 +661,7 @@ def tokenize_planes(text: bytes, n_samples: int, words: int) -> dict:
                 weakref.finalize(
                     arr, lib.sbn_free, ctypes.cast(v, u8p)
                 )
+                finalized.add(k)
                 result[k] = arr
             else:
                 result[k] = arr.copy()
@@ -640,7 +673,7 @@ def tokenize_planes(text: bytes, n_samples: int, words: int) -> dict:
         )
     finally:
         for k, v in outs.items():
-            if k in planes and shapes.get(k):
+            if k in finalized:
                 continue  # freed by the finalizer above
             lib.sbn_free(ctypes.cast(v, u8p))
         lib.sbn_free(ctypes.cast(tok_over_p, u8p))

@@ -407,6 +407,14 @@ def sharded_selected_query(
     call/allele counts. ``n_overflow > 0`` means a window overflowed
     and the caller must re-answer those datasets host-side, as in
     ``sharded_query``.
+
+    Aggregate semantics caveat: call/allele counts sum over ALL matched
+    records, which equals ``materialize_response`` only for the
+    include_details shapes (granularity record/aggregated with details).
+    Boolean / no-details responses truncate at the first positive-count
+    record (``call_count = cum[k0]``, AN through k0) — serving callers
+    must route those granularities to the per-dataset engine path, like
+    the ploidy>2 saturation side-tables (host-only) noted above.
     """
     enc = (
         encode_queries(queries) if isinstance(queries, list) else queries

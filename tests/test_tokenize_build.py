@@ -227,3 +227,22 @@ def test_fused_matches_unfused_tokenizer(monkeypatch):
         b = {tuple(r) for r in getattr(unfused, attr).tolist()}
         assert a == b, attr
     assert len({tuple(r) for r in fused.gt_overflow.tolist()}) > 0
+
+
+def test_tokenize_planes_uint64_argtypes_declared():
+    """sbn_tokenize_planes' uint64 params (len, n_samples, words) MUST be
+    declared as c_uint64: the ctypes default marshals them as 32-bit C
+    ints, silently truncating >= 2^32 (a >= 2 GiB decompressed ingest
+    slice would mis-parse with no error on the fused hot path).
+    Regression for ADVICE r4 (medium)."""
+    import ctypes
+
+    lib = native.get_lib()
+    if lib is None or not hasattr(lib, "sbn_tokenize_planes"):
+        pytest.skip("native library unavailable")
+    at = lib.sbn_tokenize_planes.argtypes
+    assert at is not None, "argtypes undeclared: u64 params truncate"
+    assert at[1] is ctypes.c_uint64  # len
+    assert at[2] is ctypes.c_uint64  # n_samples
+    assert at[3] is ctypes.c_uint64  # words
+    assert lib.sbn_tokenize_planes.restype is ctypes.c_int
