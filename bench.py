@@ -1,14 +1,22 @@
 """Headline benchmark + BASELINE.md config suite — 1000-Genomes scale.
 
-Prints ONE JSON line. Round-3 rework (VERDICT r2 #1): every query config
-runs against a 1000-Genomes-shaped corpus — >=2e7 index rows across
-chr1-22 at real length proportions with 2504-sample-wide genotype
-planes — instead of round 2's <=101k-row toy. The headline metric is
-BASELINE config 2 (10k batched SNV point queries on one chip); detail
-carries the other configs, a v5e roofline statement, skew-distribution
-spreads, a selected-samples config at full sample width, a concurrent
-HTTP soak with micro-batcher occupancy, and the real-pipeline ingest
-probe (plus the out-of-band INGEST_r03.json full-corpus manifest).
+Prints the headline JSON line INCREMENTALLY: after every config the
+full cumulative record is re-emitted on its own line (marked
+``"partial": true`` until the final one), so a run cut off by the
+driver's wall-clock budget still leaves the last complete line as a
+parseable record — round 4's single end-of-run print left ``rc: 124``
+and nothing else (VERDICT r4 weak #1). Three more budget rules from
+the same failure: corpora come from the mmap-backed disk cache
+(``harness/bench_cache.py`` — built once, reused by every run AND by
+the co-located CPU subprocess probes), every config runs under a
+remaining-budget check with a graceful ``skipped`` record, and each
+config is individually exception-isolated.
+
+Every query config runs against a 1000-Genomes-shaped corpus —
+>=2e7 index rows across chr1-22 at real length proportions (r3 rework)
+— with the selected-samples config on a 2504-sample-wide plane corpus
+sized so its HBM upload fits the tunnel budget (rows reported
+explicitly; BENCH_PLANE_ROWS scales it).
 
 Baseline derivation (the reference publishes no numbers — BASELINE.md):
 the reference answers each point query with a splitQuery->performQuery
@@ -16,8 +24,9 @@ lambda chain whose concurrency ceiling is 1000 lambdas and per-query
 latency ~1 s (bcftools region scan at the reference's assumed 75 MB/s),
 so its ceiling ~= 1000 queries/sec. ``vs_baseline`` is measured-qps/1000.
 
-Scale knobs: BENCH_ROWS (default 20_000_000) and BENCH_SAMPLES (default
-2504) — the driver's run uses the defaults; smaller values exist for
+Scale knobs: BENCH_ROWS (default 20_000_000), BENCH_SAMPLES (default
+2504), BENCH_PLANE_ROWS (default 2_000_000), BENCH_BUDGET_S (default
+700) — the driver's run uses the defaults; smaller values exist for
 smoke-testing the bench itself, and the emitted detail always reports
 the sizes actually used (nothing shrinks silently).
 """
@@ -33,9 +42,16 @@ import traceback
 
 N_ROWS = int(os.environ.get("BENCH_ROWS", 20_000_000))
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 2504))
+PLANE_ROWS = int(os.environ.get("BENCH_PLANE_ROWS", 2_000_000))
+BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", 700))
 N_QUERIES = 10_000
 REPEATS = 6
 BASELINE_QPS = 1000.0
+_T_START = time.monotonic()
+
+
+def _remaining() -> float:
+    return BUDGET_S - (time.monotonic() - _T_START)
 
 # v5e (this box reports 'TPU v5 lite'): 16 GB HBM2 @ 819 GB/s peak,
 # 197 bf16 TFLOP/s — the public spec sheet numbers the roofline uses
@@ -72,22 +88,21 @@ def _pipelined_qps(fn, n_queries, *, reps=16, threads=8, rounds=2):
 
 def build_corpus():
     """The 1000-Genomes-shaped serving corpus: chr1-22, N_ROWS rows,
-    N_SAMPLES-wide genotype planes (plane_density=0.25 keeps the build
-    to two RNG passes; denser-than-real planes make the popcount paths
-    a conservative measurement, never a flattering one)."""
-    from sbeacon_tpu.testing import synthetic_shard
+    mmap-cached on disk (VERDICT r4 #1). Planes live on the separate
+    config7 corpus — the 2e7-row query configs never read them, and
+    dropping them cuts the one-time build from ~282 s (r3 capture) to
+    ~30 s and the cache load to milliseconds."""
+    from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
 
     t0 = time.perf_counter()
-    shard = synthetic_shard(
+    shard, build_s = cached_synthetic_shard(
         N_ROWS,
         n_samples=N_SAMPLES,
-        with_gt_planes=True,
-        plane_density=0.25,
         seed=11,
         dataset_id="bench1kg",
     )
-    build_s = time.perf_counter() - t0
-    return shard, build_s
+    load_s = time.perf_counter() - t0 - build_s
+    return shard, build_s, load_s
 
 
 def _point_specs(shard, n, seed=5, miss_every=2):
@@ -383,7 +398,7 @@ def config1_single_snv(shard, sindex):
     # CPU device time) + TPU device time. Every term is measured; the
     # derivation is the only arithmetic step (VERDICT r3 #4).
     try:
-        vals = _run_colocated_probe(_COLOCATED_PROBE, timeout=900)
+        vals = _run_colocated_probe(_COLOCATED_PROBE, timeout=min(300, max(60, _remaining())))
         if "p50_ms" in vals:
             out["colocated_cpu_p50_ms"] = round(vals["p50_ms"], 3)
             if "cpu_device_us" in vals:
@@ -410,13 +425,14 @@ import os, random, time
 from sbeacon_tpu.config import BeaconConfig, EngineConfig
 from sbeacon_tpu.engine import VariantEngine
 from sbeacon_tpu.payloads import VariantQueryPayload
-from sbeacon_tpu.testing import synthetic_shard
+from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
 
 # FULL bench corpus size (VERDICT r3 #4: the co-located full-stack term
 # of the north-star decomposition must be measured at 2e7 rows, not a
-# toy): same rows, narrower planes (the single-SNV path touches none)
+# toy): same rows, no planes (the single-SNV path touches none);
+# mmap-cached so the subprocess pays the build at most once ever
 rows = int(os.environ.get("BENCH_ROWS", 20_000_000))
-shard = synthetic_shard(rows, n_samples=16, seed=7, dataset_id="co")
+shard, _b = cached_synthetic_shard(rows, n_samples=16, seed=7, dataset_id="co")
 engine = VariantEngine(BeaconConfig(engine=EngineConfig(use_mesh=False)))
 engine.add_index(shard)
 rng = random.Random(23)
@@ -513,8 +529,8 @@ def config4_multi_dataset():
     from sbeacon_tpu.config import BeaconConfig, EngineConfig
     from sbeacon_tpu.engine import VariantEngine
     from sbeacon_tpu.ingest.pipeline import distinct_variant_count
+    from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
     from sbeacon_tpu.payloads import VariantQueryPayload
-    from sbeacon_tpu.testing import synthetic_shard
 
     engine = VariantEngine(
         BeaconConfig(engine=EngineConfig(use_mesh=False, microbatch=False))
@@ -522,7 +538,7 @@ def config4_multi_dataset():
     shards = []
     n_ds = 8
     for d in range(n_ds):
-        s = synthetic_shard(
+        s, _b = cached_synthetic_shard(
             1_000_000,
             seed=100 + d,
             dataset_id=f"d{d}",
@@ -698,10 +714,37 @@ def config6_ingest():
     return out
 
 
-def config7_selected_samples(shard, sindex):
+def config7_selected_samples():
     """Selected-samples queries at full 2504-sample plane width (the
     restricted-counting leaf) + vectorised host materialisation on
-    record queries returning >=1e4 rows (VERDICT r2 #3/#7)."""
+    record queries returning >=1e4 rows (VERDICT r2 #3/#7).
+
+    Runs on its own PLANE_ROWS-row corpus (default 2e6): the full
+    2e7-row plane set is ~10 GB of HBM whose upload alone blew the r4
+    driver budget through the tunnel; the plane-reduction rates being
+    measured are per-row and the row count is reported, nothing
+    shrinks silently. BENCH_PLANE_ROWS=20000000 reproduces the r4
+    shape out-of-band."""
+    from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
+    from sbeacon_tpu.ops.scatter_kernel import ScatterDeviceIndex
+
+    shard, plane_build_s = cached_synthetic_shard(
+        PLANE_ROWS,
+        n_samples=N_SAMPLES,
+        with_gt_planes=True,
+        plane_density=0.25,
+        seed=11,
+        dataset_id="bench1kg",
+    )
+    sindex = ScatterDeviceIndex(shard)
+    out = _config7_body(shard, sindex)
+    out["plane_corpus_rows"] = shard.n_rows
+    if plane_build_s:
+        out["plane_corpus_build_s"] = round(plane_build_s, 1)
+    return out
+
+
+def _config7_body(shard, sindex):
     from sbeacon_tpu.engine import (
         VariantEngine,
         host_match_rows,
@@ -754,7 +797,10 @@ def config7_selected_samples(shard, sindex):
     # loops: the p50 split must compare plane residency, not different
     # random genomic windows
     query_rows = [rng.randrange(shard.n_rows) for _ in range(15)]
+    from sbeacon_tpu.ops import scatter_kernel as _sk
+
     lat = []
+    d0 = _sk.N_DISPATCHES
     for r in query_rows:
         payload = VariantQueryPayload(
             dataset_ids=["bench1kg"],
@@ -773,11 +819,15 @@ def config7_selected_samples(shard, sindex):
         t0 = time.perf_counter()
         engine.search(payload)
         lat.append(time.perf_counter() - t0)
+    dispatches = _sk.N_DISPATCHES - d0
     lat.sort()
     out = {
         "n_selected": len(selected),
         "plane_width_words": int(shard.gt_bits.shape[1]),
         "p50_ms": round(lat[len(lat) // 2] * 1e3, 2),
+        # the fused match+planes contract (VERDICT r4 next #2): the
+        # whole selected-samples request costs ONE kernel program
+        "dispatches_per_request": round(dispatches / len(query_rows), 2),
         "device_planes": pindex is not None,
     }
     if pindex is not None:
@@ -917,10 +967,12 @@ import random, time
 from sbeacon_tpu.config import BeaconConfig, EngineConfig
 from sbeacon_tpu.engine import VariantEngine
 from sbeacon_tpu.payloads import VariantQueryPayload
-from sbeacon_tpu.testing import synthetic_shard
+from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
 
-shard = synthetic_shard(
-    2_000_000, n_samples=256, with_gt_planes=True, plane_density=0.25,
+import os
+rows = int(os.environ.get("BENCH_CO_ROWS", 2_000_000))
+shard, _b = cached_synthetic_shard(
+    rows, n_samples=256, with_gt_planes=True, plane_density=0.25,
     seed=7, dataset_id="co")
 engine = VariantEngine(BeaconConfig(engine=EngineConfig(use_mesh=False)))
 engine.add_index(shard)
@@ -958,11 +1010,11 @@ def config8_skew():
         device_time_probe,
         run_queries_scattered,
     )
-    from sbeacon_tpu.testing import synthetic_shard
+    from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
 
     out = {}
     for model in ("uniform", "clustered"):
-        shard = synthetic_shard(
+        shard, _b = cached_synthetic_shard(
             5_000_000,
             seed=77,
             dataset_id=f"skew-{model}",
@@ -1062,7 +1114,7 @@ def config9_soak(shard, sindex):
     # stack; the tail bar is p99 <= 5x p50 when transport is out of the
     # picture
     try:
-        vals = _run_colocated_probe(_COLOCATED_SOAK_PROBE, timeout=420)
+        vals = _run_colocated_probe(_COLOCATED_SOAK_PROBE, timeout=min(240, max(60, _remaining())))
         if "json" in vals:
             out["colocated_cpu"] = vals["json"]
     except Exception:
@@ -1079,9 +1131,11 @@ from sbeacon_tpu.api import BeaconApp
 from sbeacon_tpu.api.server import start_background
 from sbeacon_tpu.config import BeaconConfig, EngineConfig, StorageConfig
 from sbeacon_tpu.harness.latency import run_concurrent_soak
-from sbeacon_tpu.testing import synthetic_shard
+from sbeacon_tpu.harness.bench_cache import cached_synthetic_shard
 
-shard = synthetic_shard(2_000_000, n_samples=16, seed=7, dataset_id="co")
+import os
+rows = int(os.environ.get("BENCH_CO_ROWS", 2_000_000))
+shard, _b = cached_synthetic_shard(rows, n_samples=16, seed=7, dataset_id="co")
 with tempfile.TemporaryDirectory(prefix="co-soak-") as td:
     cfg = BeaconConfig(
         storage=StorageConfig(root=Path(td)),
@@ -1117,49 +1171,103 @@ with tempfile.TemporaryDirectory(prefix="co-soak-") as td:
 
 
 def main() -> None:
-    t_all = time.perf_counter()
-    shard, build_s = build_corpus()
-    from sbeacon_tpu.ops.scatter_kernel import ScatterDeviceIndex
+    detail: dict = {"budget_s": BUDGET_S}
+    headline = {"qps": 0.0}
 
-    t0 = time.perf_counter()
-    sindex = ScatterDeviceIndex(shard)
-    upload_s = time.perf_counter() - t0
+    def emit(final: bool = False) -> None:
+        """Re-print the full cumulative record (VERDICT r4 weak #1: a
+        timeout must still leave the last complete line parseable)."""
+        detail["bench_wall_s"] = round(time.monotonic() - _T_START, 1)
+        detail["partial"] = not final
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        "batched_point_queries_single_chip_20M_rows"
+                    ),
+                    "value": round(headline["qps"], 1),
+                    "unit": "queries/sec",
+                    "vs_baseline": round(
+                        headline["qps"] / BASELINE_QPS, 2
+                    ),
+                    "detail": detail,
+                }
+            ),
+            flush=True,
+        )
 
-    qps, d2 = config2_point_queries(shard, sindex)
-    detail = {
-        "index_rows": shard.n_rows,
-        "n_samples": shard.meta["sample_count"],
-        "chroms": 22,
-        "corpus_build_s": round(build_s, 1),
-        "index_upload_s": round(upload_s, 1),
-        "index_hbm_gb": round(sindex.nbytes() / 1e9, 2),
-        "roofline": {
+    # the preamble itself must not reproduce the rc:124-with-no-output
+    # failure: emit a parseable record FIRST and again after every
+    # stage, and record (not raise) a corpus/upload failure
+    emit()
+    try:
+        shard, build_s, load_s = build_corpus()
+        from sbeacon_tpu.ops.scatter_kernel import ScatterDeviceIndex
+
+        t0 = time.perf_counter()
+        sindex = ScatterDeviceIndex(shard)
+        upload_s = time.perf_counter() - t0
+    except Exception:
+        traceback.print_exc(file=sys.stderr)
+        detail["error"] = (
+            "corpus/upload preamble failed: "
+            + traceback.format_exc(limit=1).strip()[-300:]
+        )
+        emit(final=True)
+        return
+    detail.update(
+        index_rows=shard.n_rows,
+        n_samples=shard.meta["sample_count"],
+        chroms=22,
+        corpus_build_s=round(build_s, 1),
+        corpus_cache_load_s=round(load_s, 1),
+        index_upload_s=round(upload_s, 1),
+        index_hbm_gb=round(sindex.nbytes() / 1e9, 2),
+        roofline={
             "chip": "TPU v5e (v5 lite), 1 chip",
             "hbm_peak_gb_per_s": V5E_HBM_PEAK_GBPS,
         },
-        "n_queries": N_QUERIES,
-        **d2,
-        "config1_single_snv": config1_single_snv(shard, sindex),
-        "config3_bracket_chr1_22": config3_brackets(shard, sindex),
-        "config4_multi_dataset": config4_multi_dataset(),
-        "config5_sv_indel": config5_sv_indel(shard, sindex),
-        "config6_ingest": config6_ingest(),
-        "config7_selected_samples": config7_selected_samples(shard, sindex),
-        "config8_skew": config8_skew(),
-        "config9_soak": config9_soak(shard, sindex),
-    }
-    detail["bench_wall_s"] = round(time.perf_counter() - t_all, 1)
-    print(
-        json.dumps(
-            {
-                "metric": "batched_point_queries_single_chip_20M_rows",
-                "value": round(qps, 1),
-                "unit": "queries/sec",
-                "vs_baseline": round(qps / BASELINE_QPS, 2),
-                "detail": detail,
-            }
-        )
+        n_queries=N_QUERIES,
     )
+    emit()
+
+    def run(key: str, est_s: float, fn) -> None:
+        """One config under the budget: skip (with the reason recorded)
+        when the estimated cost exceeds what remains, isolate failures,
+        re-emit the cumulative record either way."""
+        left = _remaining()
+        if left < est_s:
+            detail[key] = {
+                "skipped": f"budget: {left:.0f}s left < ~{est_s:.0f}s est"
+            }
+        else:
+            t0 = time.monotonic()
+            try:
+                out = fn()
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                out = {"error": traceback.format_exc(limit=1).strip()[-300:]}
+            if isinstance(out, dict):
+                out["config_wall_s"] = round(time.monotonic() - t0, 1)
+            detail[key] = out
+        emit()
+
+    # headline first: even a budget-starved run records config2
+    def c2() -> dict:
+        qps, d2 = config2_point_queries(shard, sindex)
+        headline["qps"] = qps
+        return d2
+
+    run("config2_point_queries", 120, c2)
+    run("config1_single_snv", 120, lambda: config1_single_snv(shard, sindex))
+    run("config3_bracket_chr1_22", 60, lambda: config3_brackets(shard, sindex))
+    run("config4_multi_dataset", 100, config4_multi_dataset)
+    run("config5_sv_indel", 60, lambda: config5_sv_indel(shard, sindex))
+    run("config6_ingest", 90, config6_ingest)
+    run("config7_selected_samples", 120, config7_selected_samples)
+    run("config8_skew", 80, config8_skew)
+    run("config9_soak", 120, lambda: config9_soak(shard, sindex))
+    emit(final=True)
 
 
 if __name__ == "__main__":
