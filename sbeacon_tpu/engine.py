@@ -361,6 +361,7 @@ def materialize_response(
     vcf_location: str = "",
     selected_idx: list[int] | None = None,
     plane_index=None,
+    fused=None,
 ) -> VariantSearchResponse:
     """Vectorised row-id materialisation (cumulative-order semantics).
 
@@ -381,6 +382,14 @@ def materialize_response(
     The truncation/AN/overflow semantics are computed on host from the
     device-returned scalars and are bit-identical to the host path (the
     ploidy>2 overflow side tables stay host-applied either way).
+
+    ``fused`` short-circuits BOTH plane reads with outputs the fused
+    match+planes kernel already computed in the match dispatch
+    (``scatter_kernel.run_selected_scattered`` — zero additional device
+    calls here): a ``(pc_call, pc_tok, or_words)`` triple where
+    pc_call/pc_tok are per-row masked popcounts aligned with ``rows``
+    and or_words is the sample-hit OR over the grp>=k0 subset.
+    Takes precedence over ``plane_index``.
     """
     c = shard.cols
     rows = np.asarray(rows, dtype=np.int64)
@@ -441,7 +450,8 @@ def materialize_response(
     )
     dev_counts = None
     if (
-        plane_index is not None
+        fused is None
+        and plane_index is not None
         and plane_index.has_counts
         and (len(gt_rows) or len(tok_grps))
     ):
@@ -455,7 +465,9 @@ def materialize_response(
     if count_planes and len(gt_rows):
         rr = rows[gt_rows]
         extras = _overflow_extras(shard, "gt", rr, sel_mask)
-        if dev_counts is not None:
+        if fused is not None:
+            rc[gt_rows] = fused[0][gt_rows].astype(np.int64) + extras
+        elif dev_counts is not None:
             pc = dev_counts[: len(gt_rows)]
             rc[gt_rows] = pc[:, 0] + pc[:, 1] + extras
         else:
@@ -475,7 +487,11 @@ def materialize_response(
     if count_planes and len(tok_grps):
         rr = r0[tok_grps]
         extras = _overflow_extras(shard, "tok", rr, sel_mask)
-        if dev_counts is not None:
+        if fused is not None:
+            an_grp[tok_grps] = (
+                fused[1][starts[tok_grps]].astype(np.int64) + extras
+            )
+        elif dev_counts is not None:
             tk = dev_counts[len(gt_rows) :]
             an_grp[tok_grps] = tk[:, 2] + tk[:, 3] + extras
         else:
@@ -533,7 +549,14 @@ def materialize_response(
         and shard.gt_bits is not None
     ):
         srows = rows[grp_of >= k0]
-        if plane_index is not None:
+        if fused is not None:
+            # the fused kernel already OR-reduced the grp>=k0 subset
+            # in the match dispatch (rc positivity — and therefore k0
+            # and the subset — is ploidy-extras-invariant)
+            agg = np.asarray(fused[2], dtype=np.uint32)
+            if mask is not None:
+                agg = agg & mask
+        elif plane_index is not None:
             # device OR-reduction over the exact grp>=k0 subset (k0 is
             # host-known by now in every case, so one dispatch is exact)
             from .ops.plane_kernel import plane_row_stats
@@ -855,7 +878,23 @@ class VariantEngine:
         def _one_target(target):
             ds, vcf, shard, dindex, planes, native = target
             selected_idx = None
+            fused = None
+            rows = None
             if payload.selected_samples_only:
+                selected_idx = self._selected_idx(shard, payload, ds)
+            if planes is not None and self._wants_planes(payload):
+                # fused match+planes program: the whole selected-samples
+                # (or sample-extraction) leaf in ONE kernel dispatch —
+                # the reference worker's single match+extract pass
+                # (search_variants.py:233-258). Falls through to the
+                # split path on overflow/wildcard-ref.
+                got = self._fused_selected(
+                    shard, dindex, planes, spec_base, payload,
+                    selected_idx,
+                )
+                if got is not None:
+                    rows, fused = got
+            if rows is None and payload.selected_samples_only:
                 # selected-samples leaf (reference performQuery/
                 # lambda_function.py:43-46 switches to
                 # search_variants_in_samples): row matching runs on device
@@ -863,7 +902,6 @@ class VariantEngine:
                 # the in-samples regex semantics diverge from the exact
                 # kernel compare); counting is then sample-restricted in
                 # materialize_response via the genotype bit planes
-                selected_idx = self._selected_idx(shard, payload, ds)
                 if dindex is not None and self._device_ref_ok(
                     payload, spec_base
                 ):
@@ -874,9 +912,9 @@ class VariantEngine:
                     rows = host_match_rows(
                         shard, spec_base, ref_wildcard=True
                     )
-            elif dindex is None:
+            elif rows is None and dindex is None:
                 rows = host_match_rows(shard, spec_base)
-            else:
+            elif rows is None:
                 rows = self._device_rows(shard, dindex, spec_base)
             return materialize_response(
                 shard,
@@ -887,6 +925,7 @@ class VariantEngine:
                 vcf_location=vcf,
                 selected_idx=selected_idx,
                 plane_index=planes,
+                fused=fused,
             )
 
         if len(targets) == 1:
@@ -898,6 +937,73 @@ class VariantEngine:
             responses = list(self._scatter.map(_one_target, targets))
         sp.note(targets=len(targets), responses=len(responses))
         return responses
+
+    @staticmethod
+    def _wants_planes(payload) -> bool:
+        """Queries whose response READS genotype planes: the selected-
+        samples leaf, or sample-hit extraction on record/aggregated
+        shapes WITH details (materialize's extraction block requires
+        include_details). Everything else never touches the planes and
+        takes the (micro-batched) match-only path."""
+        return payload.selected_samples_only or (
+            payload.include_samples
+            and payload.include_details
+            and payload.requested_granularity in ("record", "aggregated")
+        )
+
+    def _fused_selected(
+        self, shard, dindex, planes, spec_base, payload, selected_idx
+    ):
+        """ONE-dispatch match + plane reduction via the fused kernel.
+
+        Returns (rows, (pc_call, pc_tok, or_words)) for
+        materialize_response, or None when this query must take the
+        split path: non-scatter index, wildcard-ref regex semantics,
+        or window/record overflow (the uncapped host matcher then
+        answers, exactly like the match kernel's overflow contract).
+        """
+        from .ops.plane_kernel import sample_mask_words
+        from .ops.scatter_kernel import (
+            ScatterDeviceIndex,
+            run_selected_scattered,
+        )
+
+        if not isinstance(dindex, ScatterDeviceIndex):
+            return None
+        if not self._device_ref_ok(payload, spec_base):
+            return None
+        eng = self.config.engine
+        if selected_idx is not None:
+            mask = sample_mask_words(selected_idx, planes.n_words)
+        else:
+            mask = np.full(planes.n_words, 0xFFFFFFFF, np.uint32)
+        try:
+            res = run_selected_scattered(
+                dindex,
+                planes,
+                [spec_base],
+                mask[None, :],
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+                with_counts=(
+                    selected_idx is not None and planes.has_counts
+                ),
+            )
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "fused selected kernel failed; split path serves"
+            )
+            return None
+        if res.overflow[0]:
+            return None
+        keep = res.rows[0] >= 0
+        rows = res.rows[0][keep].astype(np.int64)
+        fused = (
+            res.pc_call[0][keep],
+            res.pc_tok[0][keep],
+            res.or_words[0],
+        )
+        return rows, fused
 
     # -- mesh serving path --------------------------------------------------
 

@@ -1,6 +1,7 @@
 """Scattered-window variant-query kernel (XLA gather + vectorised algebra).
 
-Why this exists: the grouped Pallas kernel (``pallas_kernel.py``) packs
+Why this exists: the round-2 grouped Pallas kernel (deleted in r5;
+see git history for the measured comparison) packed
 G=64 start-sorted queries per shared tile pair, which amortises HBM
 traffic G-fold **only while queries are dense relative to the index** —
 at the round-2 bench scale (~100k rows) consecutive sorted queries sit
@@ -27,8 +28,8 @@ interpret mode needed). Per-query cost is now proportional to the
 split across window-cap tiers so point queries never pay a wide
 bracket's gather (window-adaptive tiles, VERDICT r2 next #2).
 
-Matching semantics are IDENTICAL to ``ops.kernel._query_one`` /
-``pallas_kernel._pallas_kernel`` (the exact spec of the reference's
+Matching semantics are IDENTICAL to ``ops.kernel._query_one``
+(the exact spec of the reference's
 matcher, performQuery/search_variants.py:84-254) — same predicates,
 same '<None' artifact, same AN-once-per-matching-record rule. The
 "first matched row of each record" computation needs no rec_id column:
@@ -188,29 +189,14 @@ class ScatterDeviceIndex:
         return int(self.tiles.size) * 4
 
 
-@partial(
-    jax.jit, static_argnames=("T", "CAP", "nslots", "C", "exact_only")
-)
-def _scatter_batch(
-    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False
-):
-    """One fixed-size device batch: C-tile gather + vectorised predicates.
+def _scatter_core(tiles, tile_ids, qarr, *, T, CAP, C=None, exact_only=False):
+    """Traced core shared by the match-only and fused-selected batch
+    programs: C-tile gather + the vectorised predicate stack.
 
-    ``tile_ids``: [nslots] int32 (padding slots point at tile 0 with
-    lo=hi=0 so nothing matches). ``qarr``: [nslots, 8] packed queries
-    (query_pack.pack_q8 encoding — shared with the grouped kernel).
-    By default ``C = CAP//T + 1`` consecutive tiles cover any window of
-    width <= CAP whose start lies anywhere inside the first tile. The
-    single-tile fast tier passes ``C=1`` explicitly (half the HBM
-    gather of the C=2 tier): the caller guarantees every query's
-    window lies inside ONE tile (``lo//T == (hi-1)//T``), so one tile
-    covers it. ``exact_only=True`` is a static specialisation for
-    batches whose queries are ALL MODE_EXACT (the dominant point-lookup
-    shape): the symbolic variant-type predicate chain and its flag/k
-    extraction drop out of the compiled program (~1.35x on v5e —
-    the C=1 batch is no longer purely gather-bound, so VPU work
-    matters). Returns (agg [nslots, 8] int32,
-    masks [nslots, C*T/16] int32).
+    Returns ``(agg, masks, m_i, win, gidx, lo)`` — agg/masks are the
+    public results; m_i/win/gidx/lo let the fused program reduce the
+    genotype planes over the SAME gathered window without re-deriving
+    the match semantics (one source of truth for the predicate stack).
     """
     from .query_pack import (
         Q_ALT_HASH,
@@ -377,7 +363,329 @@ def _scatter_batch(
     nw = span // 16
     weights = (1 << jnp.arange(16, dtype=jnp.int32))[None, None, :]
     masks = jnp.sum(m_i.reshape(-1, nw, 16) * weights, axis=2)
+    return agg, masks, m_i, win, gidx, lo
+
+
+@partial(
+    jax.jit, static_argnames=("T", "CAP", "nslots", "C", "exact_only")
+)
+def _scatter_batch(
+    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False
+):
+    """One fixed-size device batch: C-tile gather + vectorised predicates.
+
+    ``tile_ids``: [nslots] int32 (padding slots point at tile 0 with
+    lo=hi=0 so nothing matches). ``qarr``: [nslots, 8] packed queries
+    (query_pack.pack_q8 encoding).
+    By default ``C = CAP//T + 1`` consecutive tiles cover any window of
+    width <= CAP whose start lies anywhere inside the first tile. The
+    single-tile fast tier passes ``C=1`` explicitly (half the HBM
+    gather of the C=2 tier): the caller guarantees every query's
+    window lies inside ONE tile (``lo//T == (hi-1)//T``), so one tile
+    covers it. ``exact_only=True`` is a static specialisation for
+    batches whose queries are ALL MODE_EXACT (the dominant point-lookup
+    shape): the symbolic variant-type predicate chain and its flag/k
+    extraction drop out of the compiled program (~1.35x on v5e —
+    the C=1 batch is no longer purely gather-bound, so VPU work
+    matters). Returns (agg [nslots, 8] int32,
+    masks [nslots, C*T/16] int32).
+    """
+    agg, masks, _m, _w, _g, _lo = _scatter_core(
+        tiles, tile_ids, qarr, T=T, CAP=CAP, C=C, exact_only=exact_only
+    )
     return agg, masks
+
+
+@partial(
+    jax.jit,
+    static_argnames=("T", "CAP", "nslots", "C", "exact_only", "R", "with_counts"),
+)
+def _selected_batch(
+    tiles,
+    gt,
+    gt2,
+    tok1,
+    tok2,
+    tile_ids,
+    qarr,
+    mask,
+    *,
+    T,
+    CAP,
+    nslots,
+    C=None,
+    exact_only=False,
+    R=64,
+    with_counts=False,
+):
+    """Fused match + genotype-plane reduction: ONE dispatch per batch.
+
+    Extends ``_scatter_batch`` (same predicate core, same gathered
+    window) with the selected-samples leaf the engine previously paid a
+    second kernel dispatch for (VERDICT r4 next #2 — the reference's
+    worker does match + per-sample extraction in one pass,
+    performQuery/search_variants.py:233-258):
+
+    - the top-R matched lanes become global row ids in ascending row
+      order (stable argsort of the match mask — the in-device
+      ``_rows_from_masks``),
+    - their gt/count planes are gathered, masked per-query
+      (``mask`` int32 [nslots, W]) and popcounted,
+    - the sample-hit OR runs over the exact ``grp >= k0`` row subset
+      via the same segmented scans as ``parallel.mesh._local_selected``
+      (k0 = first record with positive cumulative rc; ploidy>2
+      overflow extras can never flip rc positivity — a saturated
+      2-bit plane cell popcounts >= 2 — so the device subset equals
+      the host's even though the extras themselves stay host-added).
+
+    Returns (agg [nslots,8], rows [nslots,R] global row ids (-1 pad),
+    pc_call [nslots,R], pc_tok [nslots,R], or_words [nslots,W]).
+    ``with_counts=False`` (INFO-sourced corpora) skips the three
+    count-plane gathers entirely.
+    """
+    agg, _masks, m_i, win, gidx, _lo = _scatter_core(
+        tiles, tile_ids, qarr, T=T, CAP=CAP, C=C, exact_only=exact_only
+    )
+    # top-R matched lanes, ascending (stable sort keeps lane order)
+    order = jnp.argsort(1 - m_i, axis=1, stable=True)[:, :R]
+    matched = jnp.take_along_axis(m_i, order, axis=1) != 0  # [B, R]
+    rows = jnp.where(
+        matched, jnp.take_along_axis(gidx, order, axis=1), jnp.int32(-1)
+    )
+    take = lambda r: jnp.take_along_axis(win[:, r, :], order, axis=1)
+    ac_r = take(P_AC)
+    an_r = take(P_AN)
+    flags_r = take(P_FLAGS)
+    # record segments within the gathered window: cumsum of the
+    # SAME_PREV chain breaks. Matched lanes of one record can never
+    # straddle the window start (lanes before lo are invalid), so
+    # window-local segment ids group exactly like rec_id does.
+    seg_id = jnp.cumsum(
+        1 - ((win[:, P_FLAGS, :] & SAME_PREV) != 0).astype(jnp.int32),
+        axis=1,
+    )
+    rec_r = jnp.take_along_axis(seg_id, order, axis=1)
+
+    n_rows = gt.shape[0]
+    safe = jnp.clip(rows, 0, n_rows - 1)
+    m = mask[:, None, :]  # [B, 1, W]
+    g = gt[safe] & m  # [B, R, W]
+    pcw = lambda x: jnp.sum(
+        jax.lax.population_count(x), axis=-1
+    ).astype(jnp.int32)
+    pc_gt = pcw(g)
+    if with_counts:
+        pc_call = pc_gt + pcw(gt2[safe] & m)
+        pc_tok = pcw(tok1[safe] & m) + pcw(tok2[safe] & m)
+        rc = jnp.where((flags_r & FLAG.AC_INFO) != 0, ac_r, pc_call)
+    else:
+        pc_call = pc_gt
+        pc_tok = jnp.zeros_like(pc_gt)
+        rc = ac_r
+    rc = rc * matched
+
+    # or_sel == (record index >= k0) for matched lanes — the segmented
+    # forward/backward scans from parallel.mesh._local_selected
+    rec_eff = jnp.where(matched, rec_r, jnp.int32(-2))
+    first = matched & jnp.concatenate(
+        [
+            jnp.ones_like(matched[:, :1]),
+            rec_eff[:, 1:] != rec_eff[:, :-1],
+        ],
+        axis=1,
+    )
+    c = jnp.cumsum(rc, axis=1)
+    before = c - rc
+    base = jax.lax.cummax(
+        jnp.where(first, before, jnp.int32(-1)), axis=1
+    )
+    fwd_any = (c - base) > 0
+    rc_f = jnp.flip(rc, axis=1)
+    rec_f = jnp.flip(rec_eff, axis=1)
+    first_f = jnp.flip(matched, axis=1) & jnp.concatenate(
+        [
+            jnp.ones_like(matched[:, :1]),
+            rec_f[:, 1:] != rec_f[:, :-1],
+        ],
+        axis=1,
+    )
+    c_f = jnp.cumsum(rc_f, axis=1)
+    base_f = jax.lax.cummax(
+        jnp.where(first_f, c_f - rc_f, jnp.int32(-1)), axis=1
+    )
+    bwd_any = jnp.flip((c_f - base_f) > 0, axis=1)
+    or_sel = matched & ((base > 0) | fwd_any | bwd_any)
+    or_words = jax.lax.reduce(
+        jnp.where(or_sel[:, :, None], g, jnp.int32(0)),
+        np.int32(0),
+        jax.lax.bitwise_or,
+        dimensions=(1,),
+    )  # [B, W]
+    return agg, rows, pc_call, pc_tok, or_words
+
+
+class SelectedResults:
+    """run_selected_scattered outputs: QueryResults fields + the fused
+    per-row plane reductions (aligned with ``rows``)."""
+
+    __slots__ = (
+        "exists",
+        "call_count",
+        "n_variants",
+        "all_alleles_count",
+        "n_matched",
+        "overflow",
+        "rows",
+        "pc_call",
+        "pc_tok",
+        "or_words",
+    )
+
+    def __init__(self, **kw):
+        for k in self.__slots__:
+            setattr(self, k, kw[k])
+
+
+def run_selected_scattered(
+    sindex: ScatterDeviceIndex,
+    pindex,
+    queries,
+    mask_words: np.ndarray,
+    *,
+    window_cap: int | None = None,
+    record_cap: int = 1024,
+    with_counts: bool | None = None,
+) -> SelectedResults:
+    """Selected-samples query batch in ONE kernel dispatch per tier.
+
+    ``pindex``: ops.plane_kernel.PlaneDeviceIndex of the SAME shard as
+    ``sindex``. ``mask_words``: uint32 [B, W] per-query selected-sample
+    masks (all-ones rows extract the full cohort). A query whose
+    matched-row count exceeds min(record_cap, its tier cap) reports
+    ``overflow`` (its plane outputs would be truncated) and must take
+    the host path, exactly like the match kernel's window overflow.
+    """
+    enc = encode_queries(queries) if isinstance(queries, list) else queries
+    T = sindex.tile
+    window_cap = window_cap or T
+    b = len(enc["chrom"])
+    if with_counts is None:
+        with_counts = bool(pindex.has_counts)
+    W = pindex.n_words
+    mask_words = np.ascontiguousarray(mask_words, dtype=np.uint32)
+    if mask_words.shape != (b, W):
+        raise ValueError(f"mask_words must be [{b}, {W}]")
+    if b == 0:
+        z = np.zeros(0, np.int32)
+        return SelectedResults(
+            exists=np.zeros(0, bool),
+            call_count=z,
+            n_variants=z,
+            all_alleles_count=z,
+            n_matched=z,
+            overflow=np.zeros(0, bool),
+            rows=np.zeros((0, 0), np.int32),
+            pc_call=np.zeros((0, 0), np.int32),
+            pc_tok=np.zeros((0, 0), np.int32),
+            or_words=np.zeros((0, W), np.uint32),
+        )
+    lo, hi = _window_bounds(sindex, enc)
+    q8, needs_host = pack_q8(enc, lo, hi)
+    tile_ids_all = (lo // T).astype(np.int32)
+    caps = _tier_caps(sindex, window_cap)
+    width = hi - lo
+    tier_of = np.searchsorted(np.asarray(caps), width, side="left")
+    tier_of = np.minimum(tier_of, len(caps) - 1)
+    single = (np.maximum(hi, lo + 1) - 1) // T <= tile_ids_all
+    tier_of = np.where(single & (tier_of == 0), -1, tier_of)
+
+    R_top = min(record_cap, caps[-1])
+    agg = np.zeros((b, 8), np.int32)
+    rows = np.full((b, R_top), -1, np.int32)
+    pc_call = np.zeros((b, R_top), np.int32)
+    pc_tok = np.zeros((b, R_top), np.int32)
+    or_words = np.zeros((b, W), np.uint32)
+    is_exact = enc["alt_mode"] == MODE_EXACT
+    global N_DISPATCHES
+    for ti, cap in [(-1, T)] + list(enumerate(caps)):
+        in_tier = tier_of == ti
+        R = min(record_cap, cap)
+        for exact in (True, False):
+            sel = np.flatnonzero(in_tier & (is_exact == exact))
+            if not len(sel):
+                continue
+            # chunk host-side at CHUNK_SMALL granularity: every padding
+            # slot in the fused program pays the R-row plane gather (not
+            # just the cheap tile gather), so padding 65 queries to 2048
+            # slots would cost ~30x the plane traffic — small fixed
+            # chunks bound both the waste and the compile cache
+            for a0 in range(0, len(sel), CHUNK_SMALL):
+                ss = sel[a0 : a0 + CHUNK_SMALL]
+                bb = len(ss)
+                nslots = CHUNK_SMALL
+                pad = (-bb) % nslots
+                tid = np.concatenate(
+                    [tile_ids_all[ss], np.zeros(pad, np.int32)]
+                )
+                qq = np.concatenate(
+                    [q8[ss], np.zeros((pad, 8), np.int32)]
+                )
+                mm = np.concatenate(
+                    [
+                        mask_words[ss],
+                        np.zeros((pad, W), np.uint32),
+                    ]
+                )
+                N_DISPATCHES += 1
+                a, r, pc, pt, ow = _selected_batch(
+                    sindex.tiles,
+                    pindex.gt,
+                    pindex.gt2 if with_counts else pindex.gt,
+                    pindex.tok1 if with_counts else pindex.gt,
+                    pindex.tok2 if with_counts else pindex.gt,
+                    jnp.asarray(tid),
+                    jnp.asarray(qq),
+                    jnp.asarray(mm.view(np.int32)),
+                    T=T,
+                    CAP=cap,
+                    nslots=nslots,
+                    C=1 if ti == -1 else None,
+                    exact_only=exact,
+                    R=R,
+                    with_counts=with_counts,
+                )
+                a, r, pc, pt, ow = jax.device_get((a, r, pc, pt, ow))
+                agg[ss] = np.asarray(a)[:bb]
+                rows[ss, :R] = np.asarray(r)[:bb]
+                pc_call[ss, :R] = np.asarray(pc)[:bb]
+                pc_tok[ss, :R] = np.asarray(pt)[:bb]
+                or_words[ss] = np.asarray(ow)[:bb].view(np.uint32)
+
+    # a truncated row set would silently under-reduce the planes: the
+    # per-tier R bound makes truncation part of the overflow contract
+    r_of = np.where(
+        tier_of == -1,
+        min(record_cap, T),
+        np.minimum(record_cap, np.asarray(caps)[np.maximum(tier_of, 0)]),
+    )
+    overflow = (
+        (agg[:, 5] > 0)
+        | (width > min(window_cap, caps[-1]))
+        | needs_host
+        | (agg[:, 4] > r_of)
+    )
+    return SelectedResults(
+        exists=agg[:, 0] > 0,
+        call_count=agg[:, 1],
+        n_variants=agg[:, 2],
+        all_alleles_count=agg[:, 3],
+        n_matched=agg[:, 4],
+        overflow=overflow,
+        rows=rows,
+        pc_call=pc_call,
+        pc_tok=pc_tok,
+        or_words=or_words,
+    )
 
 
 def _tier_caps(sindex: ScatterDeviceIndex, window_cap: int) -> list[int]:
@@ -661,8 +969,9 @@ def device_time_probe(
 ) -> tuple[float, int]:
     """(seconds per batch on-device, HBM bytes gathered per batch) by
     two-chain differencing through ``device_get`` — RTT, dispatch and
-    transfer cancel exactly (see pallas_kernel.device_time_probe for the
-    methodology; this backend's block_until_ready returns early).
+    transfer cancel exactly (methodology: time a k1-long and a k2-long
+    serialized in-dispatch chain and difference; this backend's
+    block_until_ready returns early, so wall-per-dispatch would lie).
 
     Times the SAME tier mix serving runs: queries whose window sits in
     one tile are timed in the C=1 fast tier (split exact/non-exact like
