@@ -218,12 +218,7 @@ def materialize_response_loop(
     # restricted genotype-derived counting needs the full plane set; a
     # shard persisted before the count planes existed degrades to the
     # full-cohort baked counts (sample extraction still restricts)
-    count_planes = (
-        mask is not None
-        and shard.gt_bits2 is not None
-        and shard.tok_bits1 is not None
-        and shard.tok_bits2 is not None
-    )
+    count_planes = mask is not None and shard.has_count_planes
     sel_set = set(selected_idx or [])
 
     def _overflow_extra(which: str, row: int) -> int:
@@ -402,12 +397,7 @@ def materialize_response(
         from .ops.plane_kernel import sample_mask_words
 
         mask = sample_mask_words(selected_idx, n_words)
-    count_planes = (
-        mask is not None
-        and shard.gt_bits2 is not None
-        and shard.tok_bits1 is not None
-        and shard.tok_bits2 is not None
-    )
+    count_planes = mask is not None and shard.has_count_planes
     n_samples = len(shard.meta.get("sample_names", []))
     sel_mask = np.zeros(max(n_samples, 1), dtype=bool)
     if selected_idx is not None:
@@ -644,6 +634,9 @@ class VariantEngine:
         self._mesh_state = None
         self._mesh_dirty = True
         self.mesh_searches = 0
+        # selected-samples queries served by the one-pjit
+        # sharded_selected_query path (VERDICT r4 next #3)
+        self.mesh_selected_searches = 0
         # key -> bytes reserved for an in-flight plane upload (counts
         # against plane_hbm_budget_gb until the planes are published)
         self._plane_reserved: dict = {}
@@ -1048,7 +1041,40 @@ class VariantEngine:
                 shards = [self._indexes[k][0] for k in keys]
                 n_mesh = int(mesh.devices.size)
                 d_pad = -(-len(shards) // n_mesh) * n_mesh
-                stacked = StackedIndex(shards, n_datasets_padded=d_pad)
+                # stack the genotype planes with their datasets when
+                # every shard has them and the per-device slice fits
+                # the plane budget: the mesh then serves the selected-
+                # samples leaf as ONE pjit program (sharded_selected_
+                # query) instead of falling back to per-dataset scatter
+                with_planes = all(
+                    s.gt_bits is not None for s in shards
+                )
+                if with_planes:
+                    # StackedIndex itself computes what its planes will
+                    # occupy per device (one source of truth with the
+                    # actual stackp allocation); resident per-dataset
+                    # planes + in-flight uploads share the same HBM and
+                    # count against the gate too
+                    per_dev = StackedIndex.plane_bytes_per_device(
+                        shards,
+                        n_datasets_padded=d_pad,
+                        n_mesh=n_mesh,
+                    )
+                    resident = sum(
+                        p.nbytes_hbm()
+                        for _s, _d, p in self._indexes.values()
+                        if p is not None
+                    ) + sum(self._plane_reserved.values())
+                    budget = (
+                        getattr(eng, "plane_hbm_budget_gb", 11.0) * 1e9
+                    )
+                    if per_dev + resident > budget:
+                        with_planes = False
+                stacked = StackedIndex(
+                    shards,
+                    n_datasets_padded=d_pad,
+                    with_planes=with_planes,
+                )
                 arrays = stacked.shard_to_mesh(mesh)
                 # the state carries its OWN shard snapshot: row ids from
                 # the stacked arrays are only valid against the exact
@@ -1075,20 +1101,62 @@ class VariantEngine:
         pjit dispatch. Per-dataset row ids come back device-sharded and
         materialise host-side with the same cumulative semantics as the
         scatter path."""
-        from .parallel.mesh import sharded_query
+        from .parallel.mesh import sharded_query, sharded_selected_query
 
         mesh, stacked, arrays, index_of, shard_of, planes_of = state
         eng = self.config.engine
-        per_ds, agg = sharded_query(
-            arrays,
-            [spec_base],
-            mesh=mesh,
-            n_iters=stacked.n_iters,
-            window_cap=eng.window_cap,
-            record_cap=eng.record_cap,
-        )
         device_ref_ok = self._device_ref_ok(payload, spec_base)
         ref_wild = payload.selected_samples_only
+
+        # selected-samples leaf over the mesh (VERDICT r4 next #3): the
+        # SAME one-pjit fan-out serves both leaf types, like the
+        # reference's splitQuery->performQuery chain switching workers
+        # (performQuery/lambda_function.py:43-46). Per-dataset rows +
+        # masked popcounts + the grp>=k0 sample-hit OR come back
+        # dataset-sharded and materialise host-side through the fused
+        # contract — no per-dataset plane dispatches.
+        selected_mesh = (
+            payload.selected_samples_only
+            and stacked.has_planes
+            and device_ref_ok
+        )
+        sel_idx_of: dict = {}
+        if selected_mesh:
+            from .ops.plane_kernel import sample_mask_words
+
+            W = stacked.plane_words
+            masks = np.zeros(
+                (stacked.n_datasets_padded, W), np.uint32
+            )
+            for ds, vcf, _s, _d, _p, _n in targets:
+                key = (ds, vcf)
+                if key not in index_of:
+                    raise KeyError(key)  # stale stack: thread scatter
+                sel_idx_of[key] = self._selected_idx(
+                    shard_of[key], payload, ds
+                )
+                masks[index_of[key]] = sample_mask_words(
+                    sel_idx_of[key], W
+                )
+            per_ds, agg = sharded_selected_query(
+                arrays,
+                [spec_base],
+                masks,
+                mesh=mesh,
+                n_iters=stacked.n_iters,
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+                has_counts=stacked.has_count_planes,
+            )
+        else:
+            per_ds, agg = sharded_query(
+                arrays,
+                [spec_base],
+                mesh=mesh,
+                n_iters=stacked.n_iters,
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+            )
 
         def _one(target):
             ds, vcf, _shard, _dindex, _planes, native = target
@@ -1099,7 +1167,10 @@ class VariantEngine:
             shard = shard_of[(ds, vcf)]
             di = index_of[(ds, vcf)]
             selected_idx = (
-                self._selected_idx(shard, payload, ds)
+                sel_idx_of.get(
+                    (ds, vcf),
+                    self._selected_idx(shard, payload, ds),
+                )
                 if payload.selected_samples_only
                 else None
             )
@@ -1107,13 +1178,36 @@ class VariantEngine:
                 bool(per_ds["overflow"][di, 0])
                 or int(per_ds["n_matched"][di, 0]) > eng.record_cap
             )
+            fused = None
             if not device_ref_ok or overflow:
                 rows = host_match_rows(
                     shard, spec_base, ref_wildcard=ref_wild
                 )
             else:
                 r = per_ds["rows"][di, 0]
-                rows = r[r >= 0]
+                keep = r >= 0
+                rows = r[keep].astype(np.int64)
+                # the device outputs are only exact for this shard when
+                # its count-plane availability matches the stack-wide
+                # static (a shard WITH count planes in a stack that ran
+                # has_counts=False was counted full-cohort on device —
+                # its restricted semantics must come from the host/
+                # plane_index path instead)
+                if selected_mesh and (
+                    stacked.has_count_planes
+                    or not shard.has_count_planes
+                ):
+                    # or_words come back stack-wide (plane_words = the
+                    # WIDEST shard); this shard's materialisation works
+                    # in its own width — truncate (tail words are zero
+                    # by construction: stack zero-padding AND the mask)
+                    w_shard = shard.gt_bits.shape[1]
+                    fused = (
+                        per_ds["pc_call"][di, 0][keep],
+                        per_ds["pc_tok"][di, 0][keep],
+                        np.asarray(per_ds["or_words"][di, 0])
+                        .view(np.uint32)[:w_shard],
+                    )
             return materialize_response(
                 shard,
                 rows,
@@ -1123,6 +1217,7 @@ class VariantEngine:
                 vcf_location=vcf,
                 selected_idx=selected_idx,
                 plane_index=planes_of.get((ds, vcf)),
+                fused=fused,
             )
 
         if len(targets) == 1:
@@ -1130,10 +1225,13 @@ class VariantEngine:
         else:
             responses = list(self._scatter.map(_one, targets))
         self.mesh_searches += 1
+        if selected_mesh:
+            self.mesh_selected_searches += 1
         sp.note(
             targets=len(targets),
             responses=len(responses),
             mesh=int(mesh.devices.size),
+            selected=selected_mesh,
             psum_exists=bool(agg["exists"][0]),
         )
         return responses

@@ -161,6 +161,17 @@ class VariantIndexShard:
     gt_overflow: np.ndarray | None = None
     tok_overflow: np.ndarray | None = None
 
+    @property
+    def has_count_planes(self) -> bool:
+        """All three restricted-counting planes present — THE predicate
+        every consumer shares (plane upload gates, StackedIndex statics,
+        mesh/materialise exactness checks) so they can never drift."""
+        return (
+            self.gt_bits2 is not None
+            and self.tok_bits1 is not None
+            and self.tok_bits2 is not None
+        )
+
     def overflow_map(self, which: str) -> dict[int, list[tuple[int, int]]]:
         """{row: [(sample, exact_value), ...]} for 'gt' or 'tok' overflow
         entries; cached."""

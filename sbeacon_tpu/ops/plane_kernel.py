@@ -77,9 +77,7 @@ class PlaneDeviceIndex:
         the budget estimate so they can never drift."""
         flags = shard.cols["flags"]
         return bool(
-            shard.gt_bits2 is not None
-            and shard.tok_bits1 is not None
-            and shard.tok_bits2 is not None
+            shard.has_count_planes
             and (
                 ((flags & FLAG.AC_INFO) == 0).any()
                 or ((flags & FLAG.AN_INFO) == 0).any()
@@ -290,13 +288,14 @@ def device_plane_probe(
             best = min(best, _time.perf_counter() - t0)
         return best
 
-    # auto-escalate the chain length: at narrow plane widths one call is
-    # sub-microsecond and the differencing signal drowns in transport
-    # jitter until the chain is long enough
-    for k_iters in (iters, iters * 4, iters * 16):
+    # auto-escalate the chain length until the signal CLEARS the
+    # transport-jitter floor (merely-positive deltas are noise — see
+    # scatter_kernel._probe_one_tier)
+    floor_s = 0.020
+    for k_iters in (iters, iters * 4, iters * 16, iters * 64):
         timed(4, reps=1)
         timed(4 + k_iters, reps=1)
         delta = timed(4 + k_iters) - timed(4)
-        if delta > 0:
+        if delta >= floor_s:
             return delta / k_iters
-    raise RuntimeError("device_plane_probe: below timing jitter")
+    raise RuntimeError("device_plane_probe: below the jitter floor")

@@ -116,10 +116,7 @@ class StackedIndex:
             self.plane_words = W
             self.has_planes = True
             self.has_count_planes = all(
-                s.gt_bits2 is not None
-                and s.tok_bits1 is not None
-                and s.tok_bits2 is not None
-                for s in shards
+                s.has_count_planes for s in shards
             )
 
             def stackp(attr):
@@ -137,6 +134,34 @@ class StackedIndex:
                 self.arrays["plane_gt2"] = stackp("gt_bits2")
                 self.arrays["plane_tok1"] = stackp("tok_bits1")
                 self.arrays["plane_tok2"] = stackp("tok_bits2")
+
+    @classmethod
+    def plane_bytes_per_device(
+        cls,
+        shards,
+        *,
+        n_datasets_padded: int,
+        n_mesh: int,
+        pad_unit: int = DeviceIndex.PAD_UNIT,
+    ) -> int:
+        """Per-device HBM bytes the stacked genotype planes will occupy
+        (incl. row padding, widest-shard W lane-rounded, and the
+        count-plane multiplicity). The engine's mesh budget gate asks
+        THIS instead of re-deriving the allocation math, so gate and
+        ``stackp`` can never drift."""
+        if not shards or any(s.gt_bits is None for s in shards):
+            return 0
+        W = max(s.gt_bits.shape[1] for s in shards)
+        n_pad = padded_rows(max(s.n_rows for s in shards), pad_unit)
+        n_planes = 4 if all(s.has_count_planes for s in shards) else 1
+        w_lane = -(-W // 128) * 128  # XLA minor-dim lane tiling
+        return (
+            -(-n_datasets_padded // n_mesh)
+            * n_pad
+            * w_lane
+            * 4
+            * n_planes
+        )
 
     def shard_to_mesh(self, mesh: Mesh, axis: str = AXIS) -> dict:
         """Device-put the stack with axis 0 partitioned over ``axis``."""
@@ -242,6 +267,8 @@ def _local_selected(
                 (flags_r & FLAG.AN_INFO) != 0, an_r, pc_tok
             )
         else:
+            pc_call = jnp.zeros_like(ac_r)
+            pc_tok = jnp.zeros_like(ac_r)
             rc = ac_r
             an_eff = an_r
         rc = rc * valid
@@ -304,6 +331,14 @@ def _local_selected(
             "or_words": or_words,
             "overflow": res["overflow"] | trunc,
             "n_matched": res["n_matched"],
+            # per-row outputs for host materialisation (the engine's
+            # mesh serving path feeds these straight into
+            # materialize_response(fused=...) — same contract as the
+            # single-device fused kernel): matched row ids and the
+            # masked popcounts, aligned
+            "rows": rows,
+            "pc_call": pc_call * valid,
+            "pc_tok": pc_tok * valid,
         }
 
     per_ds = jax.vmap(one_dataset)(arrays_local, masks_local)
