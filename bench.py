@@ -194,10 +194,19 @@ def config2_point_queries(shard, sindex):
             sindex, enc, window_cap=512, record_cap=64, with_rows=True
         )
 
+    from sbeacon_tpu.ops import scatter_kernel as _sk
+
     res = agg()  # warm-up/compile
+    d0 = _sk.N_DISPATCHES
+    agg()
     detail = {
         "hits": int(res.exists.sum()),
         "overflow": int(res.overflow.sum()),
+        # tier/exact splits each cost one RTT-bound dispatch on the
+        # tunnel — the serial-qps denominator (r5: the fast-tier split
+        # regressed serial qps vs r3's single-dispatch batch; this
+        # records the cause alongside the symptom)
+        "dispatches_per_batch": _sk.N_DISPATCHES - d0,
         "scale_parity": _scale_parity(shard, sindex, enc, res),
     }
     best = _time_batch(agg)
@@ -584,8 +593,10 @@ def config4_multi_dataset():
 
         mesh = make_mesh()
         dev = distinct_count_device(shards, mesh=mesh)  # warm+value
+        # one timed run: this is a ~23 s measurement (BENCH_r03) — three
+        # repeats bought precision the budget can't afford
         t_dev = _time_batch(
-            lambda: distinct_count_device(shards, mesh=mesh), repeats=3
+            lambda: distinct_count_device(shards, mesh=mesh), repeats=1
         )
         out["distinct"] = {
             "keys": int(sum(s.n_rows for s in shards)),
@@ -1261,10 +1272,10 @@ def main() -> None:
     run("config2_point_queries", 120, c2)
     run("config1_single_snv", 120, lambda: config1_single_snv(shard, sindex))
     run("config3_bracket_chr1_22", 60, lambda: config3_brackets(shard, sindex))
-    run("config4_multi_dataset", 100, config4_multi_dataset)
+    run("config4_multi_dataset", 170, config4_multi_dataset)
     run("config5_sv_indel", 60, lambda: config5_sv_indel(shard, sindex))
     run("config6_ingest", 90, config6_ingest)
-    run("config7_selected_samples", 120, config7_selected_samples)
+    run("config7_selected_samples", 160, config7_selected_samples)
     run("config8_skew", 80, config8_skew)
     run("config9_soak", 120, lambda: config9_soak(shard, sindex))
     emit(final=True)

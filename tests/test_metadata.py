@@ -442,3 +442,38 @@ def test_count_fast_path_respects_deletes():
     # rebuild restores the O(1) lookup
     store.rebuild_indexes()
     assert store.count("individuals", [{"id": "HP:10"}]) == 99
+
+
+def test_cross_entity_record_page_is_index_backed(store):
+    """The /datasets/{id}/individuals record page must run as an index
+    range walk, not a 1M-row scan-and-sort (VERDICT r4 next #6:
+    dataset_individuals_record p50 378 ms -> sub-ms at 1M individuals;
+    METADATA_r05). Pins both the plan and the results."""
+    store.upsert(
+        "datasets", [{"id": f"ds{d}", "name": f"D{d}"} for d in range(3)]
+    )
+    store.upsert(
+        "individuals",
+        [
+            {"id": f"i{k:03d}", "_datasetId": f"ds{k % 3}"}
+            for k in range(90)
+        ],
+    )
+    store.rebuild_indexes()
+    plan = " ".join(
+        r[-1]
+        for r in store.query(
+            "EXPLAIN QUERY PLAN SELECT _doc FROM individuals "
+            "WHERE _datasetid = ? ORDER BY id LIMIT 10 OFFSET 0",
+            ["ds1"],
+        )
+    )
+    assert "individuals_dataset_id" in plan, plan
+    assert "TEMP B-TREE" not in plan, plan  # ORDER BY rides the index
+    docs = store.fetch(
+        "individuals", [], extra_where="_datasetid = ?",
+        extra_params=["ds1"], limit=10,
+    )
+    assert [d["id"] for d in docs] == [
+        f"i{k:03d}" for k in range(90) if k % 3 == 1
+    ][:10]
