@@ -765,6 +765,92 @@ class VariantEngine:
             planes = self._build_planes(key, shard, dindex)
         self._publish_index(key, shard, dindex, planes)
 
+    def warmup(self) -> int:
+        """Pre-compile every kernel program serving can dispatch against
+        the currently loaded indexes (tiers x exact split x batch
+        shapes x fused-planes) so no request ever pays a first-compile
+        (the BENCH_r04 soak tail attribution; VERDICT r4 next #7).
+        Returns the number of programs touched. Call after (re-)ingest
+        or at server start; cached signatures make repeats near-free."""
+        from .ops.scatter_kernel import ScatterDeviceIndex, warmup_index
+
+        eng = self.config.engine
+        n = 0
+        with self._mesh_lock:
+            snapshot = list(self._indexes.values())
+        for shard, dindex, planes in snapshot:
+            if isinstance(dindex, ScatterDeviceIndex):
+                try:
+                    n += warmup_index(
+                        dindex,
+                        planes,
+                        window_cap=eng.window_cap,
+                        record_cap=eng.record_cap,
+                    )
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "kernel warmup failed for %s",
+                        shard.meta.get("dataset_id"),
+                    )
+            elif dindex is not None:
+                # XLA gather kernel (CPU fallback): compile every fixed
+                # batch-size tier run_queries pads to
+                from .ops.kernel import BATCH_TIERS
+
+                try:
+                    for t in BATCH_TIERS:
+                        run_queries_auto(
+                            dindex,
+                            [QuerySpec("1", 1, 1, 1, 2)] * t,
+                            window_cap=eng.window_cap,
+                            record_cap=eng.record_cap,
+                        )
+                        n += 1
+                except Exception:
+                    logging.getLogger(__name__).exception("warmup failed")
+        # mesh pjit programs (multi-dataset + selected-samples paths):
+        # a cold sharded_query compile mid-request is the same class of
+        # tail as a cold tier program
+        try:
+            state = self._mesh_ready()
+            if state is not None:
+                from .parallel.mesh import (
+                    sharded_query,
+                    sharded_selected_query,
+                )
+
+                mesh, stacked, arrays, _iof, _sof, _pof = state
+                probe = QuerySpec("1", 1, 1, 1, 2)
+                sharded_query(
+                    arrays,
+                    [probe],
+                    mesh=mesh,
+                    n_iters=stacked.n_iters,
+                    window_cap=eng.window_cap,
+                    record_cap=eng.record_cap,
+                    aggregates_only=True,
+                )
+                n += 1
+                if stacked.has_planes:
+                    sharded_selected_query(
+                        arrays,
+                        [probe],
+                        np.zeros(
+                            (stacked.n_datasets_padded, stacked.plane_words),
+                            np.uint32,
+                        ),
+                        mesh=mesh,
+                        n_iters=stacked.n_iters,
+                        window_cap=eng.window_cap,
+                        record_cap=eng.record_cap,
+                        has_counts=stacked.has_count_planes,
+                        aggregates_only=True,
+                    )
+                    n += 1
+        except Exception:
+            logging.getLogger(__name__).exception("mesh warmup failed")
+        return n
+
     def close(self) -> None:
         """Release the scatter pool (same contract as
         DistributedEngine.close)."""

@@ -11,32 +11,77 @@ from __future__ import annotations
 
 import json
 import time
-import urllib.request
+
 
 
 class Client:
+    """Keep-alive HTTP client (one persistent connection per client).
+
+    The server speaks HTTP/1.1 keep-alive (api/server.py); opening a
+    fresh TCP connection per request — as urllib does — makes the
+    ThreadingHTTPServer spawn a thread per REQUEST instead of per
+    client, and on a small host that thread churn alone produced a
+    >50x p50 soak tail with the kernels fully warm. Real load drivers
+    keep connections alive; so does this one.
+    """
+
     def __init__(self, base_url: str, timeout: float = 60.0):
-        self.base = base_url.rstrip("/")
+        import urllib.parse
+
+        u = urllib.parse.urlparse(base_url)
+        self.host = u.hostname
+        self.port = u.port
         self.timeout = timeout
+        self._conn = None
+
+    def _connection(self):
+        import http.client
+
+        if self._conn is None:
+            self._conn = http.client.HTTPConnection(
+                self.host, self.port, timeout=self.timeout
+            )
+        return self._conn
+
+    def _request(self, method, path, body=None, headers=None):
+        import http.client
+        import socket
+
+        for attempt in (0, 1):  # retry once over a fresh connection
+            conn = self._connection()
+            try:
+                conn.request(method, path, body=body, headers=headers or {})
+                r = conn.getresponse()
+                data = r.read()
+                return r.status, json.loads(data)
+            except socket.timeout:
+                # the server may already be executing this request —
+                # re-sending would double-submit work and report a
+                # 2x-timeout latency sample; surface the timeout
+                self._conn = None
+                raise
+            except (http.client.HTTPException, OSError):
+                # stale keep-alive (server closed between requests,
+                # reset, bad status line): safe to replay once on a
+                # fresh connection
+                self._conn = None
+                if attempt:
+                    raise
 
     def get(self, path: str, params: dict | None = None):
-        url = self.base + path
         if params:
             from urllib.parse import urlencode
 
-            url += "?" + urlencode(params)
-        with urllib.request.urlopen(url, timeout=self.timeout) as r:
-            return r.status, json.loads(r.read())
+            path += "?" + urlencode(params)
+        return self._request("GET", path)
 
     def post(self, path: str, body: dict):
-        req = urllib.request.Request(
-            self.base + path,
-            data=json.dumps(body).encode(),
+        return self._request(
+            "POST",
+            path,
+            body=json.dumps(body).encode(),
             headers={"Content-Type": "application/json"},
-            method="POST",
         )
-        with urllib.request.urlopen(req, timeout=self.timeout) as r:
-            return r.status, json.loads(r.read())
 
 
 def _timed(fn, *, reps: int = 3) -> tuple[float, object]:

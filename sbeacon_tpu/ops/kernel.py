@@ -347,6 +347,12 @@ def _query_batch(arrays, enc, *, window_cap, record_cap, n_iters):
     return jax.vmap(fn)(enc)
 
 
+# fixed batch-size tiers for compiled-program reuse (<=8x padding
+# overhead, 4 programs total); batches beyond the top tier run at
+# their exact size (bulk benchmark shapes, not serving)
+BATCH_TIERS = (8, 64, 512, 2048)
+
+
 def run_queries(
     dindex: DeviceIndex,
     queries: list[QuerySpec] | dict[str, np.ndarray],
@@ -354,10 +360,27 @@ def run_queries(
     window_cap: int = 2048,
     record_cap: int = 1024,
 ) -> QueryResults:
-    """Execute a query batch against one device index shard."""
+    """Execute a query batch against one device index shard.
+
+    The batch pads up to a fixed size tier (``BATCH_TIERS``, repeating
+    query 0 — always semantically inert, outputs trimmed) so the
+    compiled-program cache is keyed by a handful of shapes instead of
+    every micro-batch size the serving batcher can emit: un-padded, a
+    16-client soak compiled a fresh program per novel batch size
+    mid-request — the r4 soak tail (VERDICT r4 next #7).
+    """
     enc = (
         encode_queries(queries) if isinstance(queries, list) else queries
     )
+    b = int(enc["chrom"].shape[0])
+    tier = next((t for t in BATCH_TIERS if b <= t), None)
+    if b and tier and tier != b:
+        enc = {
+            k: np.concatenate(
+                [v, np.repeat(v[:1], tier - b, axis=0)]
+            )
+            for k, v in enc.items()
+        }
     with span("kernel.run_queries") as sp:
         enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
         out = _query_batch(
@@ -368,13 +391,13 @@ def run_queries(
             n_iters=dindex.n_iters,
         )
         out = jax.device_get(out)
-        sp.note(batch=int(enc["chrom"].shape[0]))
+        sp.note(batch=b)
     return QueryResults(
-        exists=np.asarray(out["exists"]),
-        call_count=np.asarray(out["call_count"]),
-        n_variants=np.asarray(out["n_variants"]),
-        all_alleles_count=np.asarray(out["all_alleles_count"]),
-        n_matched=np.asarray(out["n_matched"]),
-        overflow=np.asarray(out["overflow"]),
-        rows=np.asarray(out["rows"]),
+        exists=np.asarray(out["exists"])[:b],
+        call_count=np.asarray(out["call_count"])[:b],
+        n_variants=np.asarray(out["n_variants"])[:b],
+        all_alleles_count=np.asarray(out["all_alleles_count"])[:b],
+        n_matched=np.asarray(out["n_matched"])[:b],
+        overflow=np.asarray(out["overflow"])[:b],
+        rows=np.asarray(out["rows"])[:b],
     )

@@ -461,6 +461,14 @@ class DistributedEngine:
             )
         return self._get(url, timeout_s)
 
+    def warmup(self) -> int:
+        """Pre-compile the local engine's kernel programs (remote
+        workers warm their own at their server start); returns the
+        program count — the coordinator deployment must not be the one
+        shape the soak-tail fix skips."""
+        warm = getattr(self.local, "warmup", None)
+        return warm() if warm else 0
+
     def close(self) -> None:
         """Release the scatter pool (engines are long-lived; call this
         when rebuilding one on config/route changes)."""

@@ -79,6 +79,13 @@ class QueryJobTable:
         # no-op for :memory:
         self._conn.execute("PRAGMA journal_mode=WAL")
         self._conn.execute("PRAGMA synchronous=NORMAL")
+        # NO auto-checkpoint: whichever commit crosses the page
+        # threshold absorbs the full checkpoint fsync — on the serving
+        # thread that was a >1 s p99 outlier with warm kernels. The
+        # runner's background purge sweep calls checkpoint() instead
+        # (WAL growth bounded by one sweep interval of TTL'd cache
+        # traffic).
+        self._conn.execute("PRAGMA wal_autocheckpoint=0")
         self._lock = threading.Lock()
         self.spill_dir = Path(spill_dir) if spill_dir else None
         if self.spill_dir:
@@ -390,6 +397,13 @@ class QueryJobTable:
             Path(p).unlink(missing_ok=True)
         return n
 
+    def checkpoint(self) -> None:
+        """WAL checkpoint + truncate — called from the runner's
+        background sweep so no serving-thread commit ever absorbs the
+        checkpoint fsync (auto-checkpoint is disabled)."""
+        with self._lock:
+            self._conn.execute("PRAGMA wal_checkpoint(TRUNCATE)")
+
     def close(self) -> None:
         with self._lock:
             self._conn.close()
@@ -428,11 +442,25 @@ class AsyncQueryRunner:
         if now - self._last_purge < self.PURGE_INTERVAL_S:
             return
         self._last_purge = now
-        self.table.purge_expired()
-        with self._lock:
-            dead = [q for q, (_, exp) in self._results.items() if exp <= now]
-            for q in dead:
-                del self._results[q]
+
+        # the sweep DELETEs + commits — run it off the serving thread
+        # (piggybacked purges used to stall ~1 request per minute by a
+        # full fsync; the r5 soak tail decomposition caught it)
+        def sweep():
+            self.table.purge_expired()
+            self.table.checkpoint()
+            with self._lock:
+                dead = [
+                    q
+                    for q, (_, exp) in self._results.items()
+                    if exp <= now
+                ]
+                for q in dead:
+                    del self._results[q]
+
+        threading.Thread(
+            target=sweep, name="query-jobs-purge", daemon=True
+        ).start()
 
     def submit(
         self, payload, *, fingerprint: str | None = None
@@ -444,6 +472,12 @@ class AsyncQueryRunner:
         query_id = hash_query(
             {"payload": dataclasses.asdict(payload), "fp": fingerprint}
         )
+        # in-memory results are authoritative the moment the search
+        # finished — the table may still be mid-persistence (background)
+        with self._lock:
+            hit = self._results.get(query_id)
+        if hit is not None and hit[1] > time.time():
+            return query_id, JobStatus.COMPLETED
         status = self.table.get_job_status(query_id)
         if status is JobStatus.COMPLETED:
             return query_id, status
@@ -467,6 +501,13 @@ class AsyncQueryRunner:
                             responses,
                             time.time() + self.table.query_ttl_s,
                         )
+                    # waiters are served from the in-memory handoff the
+                    # moment the search finishes; the sqlite persistence
+                    # below exists for cross-process/restart consumers
+                    # and must not sit on the request's critical path
+                    # (a WAL checkpoint fsync here was a >1 s soak-tail
+                    # outlier with the kernels fully warm)
+                    done.set()
                     for resp in responses:
                         n = self.table.next_response_number(query_id, claim)
                         if n:
@@ -510,10 +551,13 @@ class AsyncQueryRunner:
                 ev.wait(wait_s)
             elif not self.table.wait(query_id, timeout_s=wait_s):
                 return None
-        if self.table.get_job_status(query_id) is not JobStatus.COMPLETED:
-            return None
+        # in-memory handoff FIRST: for in-process jobs the results exist
+        # the moment the search finishes, before (and regardless of) the
+        # background sqlite persistence
         with self._lock:
             hit = self._results.get(query_id)
         if hit is not None and hit[1] > time.time():
             return hit[0]
+        if self.table.get_job_status(query_id) is not JobStatus.COMPLETED:
+            return None
         return self.table.get_responses(query_id)

@@ -11,11 +11,11 @@ replacement for that entire fan-out layer at serving time.
 Leader-election design (no dedicated flusher thread, zero idle cost):
 the first request into an empty accumulator becomes the leader, waits up
 to ``max_wait_ms`` for followers (or until ``max_batch`` arrive), then
-executes the whole batch with one ``run_queries_auto`` call (XLA or
-grouped-Pallas kernel by index type) and hands each
-waiter its row of the results. Batch shapes are padded to power-of-two
-buckets so XLA compiles one program per bucket instead of one per batch
-size.
+executes the whole batch with one ``run_queries_auto`` call (scatter or
+XLA kernel by index type) and hands each waiter its row of the results.
+Batch-shape bucketing lives inside the kernels (kernel.BATCH_TIERS /
+the scatter chunk slots), so XLA compiles one program per tier instead
+of one per batch size.
 """
 
 from __future__ import annotations
@@ -31,27 +31,6 @@ import numpy as np
 from .ops import run_queries_auto
 from .ops.kernel import QueryResults, encode_queries
 from .utils.trace import span
-
-
-def bucket_size(n: int, max_batch: int) -> int:
-    """Smallest power-of-two >= n (floor 8, cap max_batch) — static shapes
-    per bucket keep XLA from recompiling on every distinct batch size."""
-    b = 8
-    while b < n:
-        b *= 2
-    return min(b, max(max_batch, 8))
-
-
-def _pad_encoded(enc: dict[str, np.ndarray], n_pad: int) -> dict:
-    """Pad a query batch by repeating row 0 (results are discarded)."""
-    n = enc["chrom"].shape[0]
-    if n == n_pad:
-        return enc
-    out = {}
-    for k, v in enc.items():
-        pad = np.repeat(v[:1], n_pad - n, axis=0)
-        out[k] = np.concatenate([v, pad], axis=0)
-    return out
 
 
 @dataclass
@@ -244,16 +223,18 @@ class MicroBatcher:
                 self._wait_ms.append((t_launch - p.t_submit) * 1e3)
         try:
             with span("serving.microbatch") as sp:
+                # shape bucketing happens INSIDE the kernels (the XLA
+                # path pads to kernel.BATCH_TIERS, the scatter path to
+                # its fixed chunk slots) — pre-padding here doubled the
+                # copy and turned pad rows into extra scatter dispatches
                 enc = encode_queries(specs)
-                n_pad = bucket_size(len(specs), self.max_batch)
-                enc = _pad_encoded(enc, n_pad)
                 res = run_queries_auto(
                     dindex,
                     enc,
                     window_cap=window_cap,
                     record_cap=record_cap,
                 )
-                sp.note(batch=len(specs), padded=n_pad)
+                sp.note(batch=len(specs))
         except BaseException as e:
             for p in batch:
                 p.error = e

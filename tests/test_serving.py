@@ -9,7 +9,7 @@ import pytest
 
 from sbeacon_tpu.index.columnar import build_index
 from sbeacon_tpu.ops.kernel import DeviceIndex, QuerySpec, run_queries
-from sbeacon_tpu.serving import MicroBatcher, bucket_size
+from sbeacon_tpu.serving import MicroBatcher
 from sbeacon_tpu.testing import random_records
 
 
@@ -35,12 +35,12 @@ def specs_for(shard, n):
     return out
 
 
-def test_bucket_size():
-    assert bucket_size(1, 512) == 8
-    assert bucket_size(8, 512) == 8
-    assert bucket_size(9, 512) == 16
-    assert bucket_size(300, 512) == 512
-    assert bucket_size(3, 4) == 8  # floor keeps a sane minimum
+def test_batch_tiers_pad_and_trim():
+    """run_queries pads to fixed BATCH_TIERS and trims outputs — the
+    shape-bucketing the batcher used to pre-do (now one place only)."""
+    from sbeacon_tpu.ops.kernel import BATCH_TIERS
+
+    assert BATCH_TIERS == (8, 64, 512, 2048)
 
 
 def test_single_submit_matches_direct(dindex):
