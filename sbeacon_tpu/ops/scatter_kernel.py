@@ -112,6 +112,10 @@ _REF_LEN_CLAMP = 0x1FFF
 CHUNK = 2048
 CHUNK_SMALL = 64
 
+# longest record (in SAME_PREV-chained rows minus one) the K-shift
+# first-match form handles; longer records take the segmented-scan form
+SEG_K_MAX = 8
+
 # device-dispatch accounting: incremented once per kernel program
 # launched (a multi-chunk _scatter_many lax.map is ONE dispatch). The
 # bench divides deltas by request count to evidence the one-dispatch-
@@ -173,6 +177,15 @@ class ScatterDeviceIndex:
         fill(P_AC, c["ac"], 0)
         fill(P_AN, c["an"], 0)
 
+        # longest SAME_PREV run = (max rows per record) - 1: lets the
+        # kernel replace the 14-pass cumsum+cummax segmented first-match
+        # scan with K cheap shifted ANDs (K is static per shard; real
+        # corpora have 1-3 alts per record so K is tiny)
+        z = np.flatnonzero(
+            np.concatenate(([0], same.astype(np.int8), [0])) == 0
+        )
+        self.seg_k = int(np.diff(z).max()) - 1
+
         # tile-major layout: tiles[t] = packed[:, t*T : (t+1)*T]
         self.tiles = jnp.asarray(
             np.ascontiguousarray(
@@ -189,7 +202,9 @@ class ScatterDeviceIndex:
         return int(self.tiles.size) * 4
 
 
-def _scatter_core(tiles, tile_ids, qarr, *, T, CAP, C=None, exact_only=False):
+def _scatter_core(
+    tiles, tile_ids, qarr, *, T, CAP, C=None, exact_only=False, seg_k=None
+):
     """Traced core shared by the match-only and fused-selected batch
     programs: C-tile gather + the vectorised predicate stack.
 
@@ -328,13 +343,32 @@ def _scatter_core(tiles, tile_ids, qarr, *, T, CAP, C=None, exact_only=False):
     # at its -1 initial value and silently drop the record's AN. Lanes
     # before lo never match, so the forced boundary cannot split a
     # record's *matched* lanes.
-    seg_begin = (1 - f(SAME_PREV)) | b2i(gidx == lo)
-    cs = jnp.cumsum(m_i, axis=1)
-    before = cs - m_i
-    seg_base = jax.lax.cummax(
-        jnp.where(seg_begin != 0, before, jnp.int32(-1)), axis=1
-    )
-    first_match = m_i & b2i(before == seg_base)
+    if seg_k is not None:
+        # K-shift formulation: a matched lane is its record's first
+        # match iff no match sits 1..K lanes earlier within an unbroken
+        # SAME_PREV chain (K = the shard's longest chain, static).
+        # Lanes before lo never match, so records straddling the window
+        # edge still count AN exactly once — no forced boundary needed.
+        same_prev = f(SAME_PREV)
+        same_before = jnp.zeros_like(m_i)
+        chain = same_prev
+        for k in range(1, seg_k + 1):
+            shifted_m = jnp.pad(m_i, ((0, 0), (k, 0)))[:, :span]
+            same_before = same_before | (chain & shifted_m)
+            if k < seg_k:
+                chain = chain & jnp.pad(
+                    same_prev, ((0, 0), (k, 0))
+                )[:, :span]
+        first_match = m_i & (1 - same_before)  # same_before is 0/1
+    else:
+        # general segmented-scan form (unbounded record length)
+        seg_begin = (1 - f(SAME_PREV)) | b2i(gidx == lo)
+        cs = jnp.cumsum(m_i, axis=1)
+        before = cs - m_i
+        seg_base = jax.lax.cummax(
+            jnp.where(seg_begin != 0, before, jnp.int32(-1)), axis=1
+        )
+        first_match = m_i & b2i(before == seg_base)
     all_alleles = jnp.sum(first_match * row(P_AN), axis=1, keepdims=True)
 
     # overflow: window wider than the cap, OR a length-clamped row
@@ -367,10 +401,12 @@ def _scatter_core(tiles, tile_ids, qarr, *, T, CAP, C=None, exact_only=False):
 
 
 @partial(
-    jax.jit, static_argnames=("T", "CAP", "nslots", "C", "exact_only")
+    jax.jit,
+    static_argnames=("T", "CAP", "nslots", "C", "exact_only", "seg_k"),
 )
 def _scatter_batch(
-    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False
+    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False,
+    seg_k=None,
 ):
     """One fixed-size device batch: C-tile gather + vectorised predicates.
 
@@ -391,14 +427,17 @@ def _scatter_batch(
     masks [nslots, C*T/16] int32).
     """
     agg, masks, _m, _w, _g, _lo = _scatter_core(
-        tiles, tile_ids, qarr, T=T, CAP=CAP, C=C, exact_only=exact_only
+        tiles, tile_ids, qarr, T=T, CAP=CAP, C=C, exact_only=exact_only,
+        seg_k=seg_k,
     )
     return agg, masks
 
 
 @partial(
     jax.jit,
-    static_argnames=("T", "CAP", "nslots", "C", "exact_only", "R", "with_counts"),
+    static_argnames=(
+        "T", "CAP", "nslots", "C", "exact_only", "R", "with_counts", "seg_k",
+    ),
 )
 def _selected_batch(
     tiles,
@@ -417,6 +456,7 @@ def _selected_batch(
     exact_only=False,
     R=64,
     with_counts=False,
+    seg_k=None,
 ):
     """Fused match + genotype-plane reduction: ONE dispatch per batch.
 
@@ -444,7 +484,8 @@ def _selected_batch(
     count-plane gathers entirely.
     """
     agg, _masks, m_i, win, gidx, _lo = _scatter_core(
-        tiles, tile_ids, qarr, T=T, CAP=CAP, C=C, exact_only=exact_only
+        tiles, tile_ids, qarr, T=T, CAP=CAP, C=C, exact_only=exact_only,
+        seg_k=seg_k,
     )
     # top-R matched lanes, ascending (stable sort keeps lane order)
     order = jnp.argsort(1 - m_i, axis=1, stable=True)[:, :R]
@@ -653,6 +694,7 @@ def run_selected_scattered(
                     exact_only=exact,
                     R=R,
                     with_counts=with_counts,
+                    seg_k=_static_seg_k(sindex),
                 )
                 a, r, pc, pt, ow = jax.device_get((a, r, pc, pt, ow))
                 agg[ss] = np.asarray(a)[:bb]
@@ -732,6 +774,7 @@ def warmup_index(
                         sindex.tiles, tid, qd,
                         T=T, CAP=cap, nslots=nslots, C=C,
                         exact_only=exact,
+                        seg_k=_static_seg_k(sindex),
                     )
                 )
                 n += 1
@@ -752,6 +795,7 @@ def warmup_index(
                             exact_only=exact,
                             R=min(record_cap, cap),
                             with_counts=bool(pindex.has_counts),
+                            seg_k=_static_seg_k(sindex),
                         )
                     )
                     n += 1
@@ -784,6 +828,13 @@ def _tier_caps(sindex: ScatterDeviceIndex, window_cap: int) -> list[int]:
     return caps
 
 
+def _static_seg_k(sindex) -> int | None:
+    """The K-shift static for this index, or None (scan form) when the
+    longest record exceeds the cheap-shift regime."""
+    k = getattr(sindex, "seg_k", None)
+    return k if k is not None and k <= SEG_K_MAX else None
+
+
 def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks, C=None, exact_only=False):
     """Device execution for one tier, chunk-padded; returns host arrays
     (agg[, masks]) trimmed to len(tile_ids). ``C=1`` is the single-tile
@@ -798,6 +849,7 @@ def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks, C=None, exact_only=Fals
     T = sindex.tile
     global N_DISPATCHES
     N_DISPATCHES += 1
+    seg_k = _static_seg_k(sindex)
     if nc == 1:
         agg, masks = _scatter_batch(
             sindex.tiles,
@@ -808,6 +860,7 @@ def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks, C=None, exact_only=Fals
             nslots=nslots,
             C=C,
             exact_only=exact_only,
+            seg_k=seg_k,
         )
     else:
         agg, masks = _scatter_many(
@@ -819,6 +872,7 @@ def _run_tier(sindex, tile_ids, q8, *, cap, fetch_masks, C=None, exact_only=Fals
             nslots=nslots,
             C=C,
             exact_only=exact_only,
+            seg_k=seg_k,
         )
         agg = agg.reshape(nc * nslots, 8)
         masks = masks.reshape(nc * nslots, -1)
@@ -928,10 +982,12 @@ def run_queries_scattered(
 
 
 @partial(
-    jax.jit, static_argnames=("T", "CAP", "nslots", "C", "exact_only")
+    jax.jit,
+    static_argnames=("T", "CAP", "nslots", "C", "exact_only", "seg_k"),
 )
 def _scatter_many(
-    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False
+    tiles, tile_ids, qarr, *, T, CAP, nslots, C=None, exact_only=False,
+    seg_k=None,
 ):
     """lax.map over fixed-size chunks (one compiled program regardless
     of logical batch size, same trick as the grouped kernel)."""
@@ -941,16 +997,19 @@ def _scatter_many(
         return _scatter_batch(
             tiles, tids, qs, T=T, CAP=CAP, nslots=nslots, C=C,
             exact_only=exact_only,
+            seg_k=seg_k,
         )
 
     return jax.lax.map(run, (tile_ids, qarr))
 
 
 @partial(
-    jax.jit, static_argnames=("T", "CAP", "nslots", "k", "C", "exact_only")
+    jax.jit,
+    static_argnames=("T", "CAP", "nslots", "k", "C", "exact_only", "seg_k"),
 )
 def _probe_rep(
-    tiles, tile_ids, qarr, *, T, CAP, nslots, k, C=None, exact_only=False
+    tiles, tile_ids, qarr, *, T, CAP, nslots, k, C=None, exact_only=False,
+    seg_k=None,
 ):
     """k serialized batch executions inside ONE dispatch.
 
@@ -967,7 +1026,7 @@ def _probe_rep(
     def body(carry, _):
         agg, _masks = _scatter_batch(
             tiles, carry, qarr, T=T, CAP=CAP, nslots=nslots, C=C,
-            exact_only=exact_only,
+            exact_only=exact_only, seg_k=seg_k,
         )
         return (carry + agg[0, 1]) % n_tiles, agg[0, 1]
 
@@ -1005,6 +1064,7 @@ def _probe_one_tier(
                         k=k,
                         C=C,
                         exact_only=exact_only,
+                        seg_k=_static_seg_k(sindex),
                     )
                 )
             )

@@ -367,3 +367,26 @@ def test_clamped_row_forces_host_fallback():
     got = run_queries_scattered(sindex, q, window_cap=128, record_cap=16)
     assert not got.overflow[0]
     assert int(got.n_matched[0]) == int(want.n_matched[0]) == 1
+
+
+def test_seg_k_shift_matches_scan_form():
+    """The K-shift first-match formulation (static seg_k) must equal the
+    general segmented-scan form on multi-alt corpora — including records
+    straddling window edges (r5 AN-scan optimisation)."""
+    from sbeacon_tpu.testing import random_records
+
+    rng = random.Random(31)
+    recs = random_records(
+        rng, chrom="1", n=500, n_samples=4, p_multiallelic=0.5
+    )
+    shard = build_index(recs, dataset_id="segk")
+    sindex = ScatterDeviceIndex(shard, tile=128)
+    assert 1 <= sindex.seg_k <= 8  # multiallelic corpus: shift form active
+    qs = _queries(shard)
+    got = run_queries_scattered(sindex, qs, window_cap=512, record_cap=64)
+    # force the scan form by lying about the static
+    sindex.seg_k = 99
+    want = run_queries_scattered(sindex, qs, window_cap=512, record_cap=64)
+    np.testing.assert_array_equal(got.all_alleles_count, want.all_alleles_count)
+    np.testing.assert_array_equal(got.call_count, want.call_count)
+    np.testing.assert_array_equal(got.n_matched, want.n_matched)
