@@ -764,20 +764,16 @@ def _config7_body(shard, sindex):
     )
     from sbeacon_tpu.config import BeaconConfig, EngineConfig
     from sbeacon_tpu.ops.kernel import QuerySpec
-    from sbeacon_tpu.ops.plane_kernel import (
-        PlaneDeviceIndex,
-        device_plane_probe,
-        plane_row_stats,
-    )
+    from sbeacon_tpu.ops.plane_kernel import PlaneDeviceIndex
     from sbeacon_tpu.payloads import VariantQueryPayload
 
     import numpy as np
 
-    # device-resident genotype planes (VERDICT r3 #2): ONE upload shared
-    # by the p50 engine, the probe, and the materialisation comparison.
-    # The INFO-sourced corpus needs only the gt plane on device
+    # device-resident genotype planes: the upload feeds the fused
+    # one-dispatch p50 engine below (and the HBM-size metric). The
+    # INFO-sourced corpus needs only the gt plane on device
     # (PlaneDeviceIndex skips count planes the counting path never
-    # reads); full-width residency at 2e7 rows is ~10 GB HBM padded.
+    # reads).
     t0 = time.perf_counter()
     try:
         pindex = PlaneDeviceIndex(shard)
@@ -804,10 +800,7 @@ def _config7_body(shard, sindex):
     names = shard.meta["sample_names"]
     selected = [names[rng.randrange(len(names))] for _ in range(100)]
     pos = shard.cols["pos"]
-    # ONE query-row list shared by the device-planes and host-planes
-    # loops: the p50 split must compare plane residency, not different
-    # random genomic windows
-    query_rows = [rng.randrange(shard.n_rows) for _ in range(15)]
+    query_rows = [rng.randrange(shard.n_rows) for _ in range(9)]
     from sbeacon_tpu.ops import scatter_kernel as _sk
 
     lat = []
@@ -847,45 +840,15 @@ def _config7_body(shard, sindex):
     else:
         out["plane_error"] = plane_err
 
-    # host-plane comparison engine (the round-3 path): on a tunnel box
-    # each device plane reduction costs a full RTT, so the end-to-end
-    # p50 split shows transport, not framework — the co-located probe
-    # below and the device-time probe are the framework numbers
-    engine_host = VariantEngine(
-        BeaconConfig(
-            engine=EngineConfig(
-                use_mesh=False, microbatch=False, device_planes=False
-            )
-        )
-    )
-    engine_host.add_prebuilt_index(shard, sindex)
-    lat_h = []
-    for r in query_rows:
-        payload = VariantQueryPayload(
-            dataset_ids=["bench1kg"],
-            reference_name=shard.row_chrom(r),
-            start_min=max(1, int(pos[r]) - 2000),
-            start_max=int(pos[r]) + 2000,
-            end_min=1,
-            end_max=2**30,
-            alternate_bases="N",
-            requested_granularity="record",
-            include_datasets="HIT",
-            include_samples=True,
-            selected_samples_only=True,
-            sample_names={"bench1kg": selected},
-        )
-        t0 = time.perf_counter()
-        engine_host.search(payload)
-        lat_h.append(time.perf_counter() - t0)
-    lat_h.sort()
-    out["p50_host_planes_ms"] = round(lat_h[len(lat_h) // 2] * 1e3, 2)
-    engine_host.close()
+    # the r4 host-vs-device-plane p50 comparison loop is retired: with
+    # the fused match+planes kernel a selected request is ONE dispatch
+    # (dispatches_per_request above is the evidence), and the second
+    # engine's extra tunnel compile (~40 s) did not fit the budget
 
     # co-located probe (CPU backend subprocess, no tunnel): the same
     # selected-samples path with device planes, RTT-free
     try:
-        vals = _run_colocated_probe(_COLOCATED_SELECTED_PROBE)
+        vals = _run_colocated_probe(_COLOCATED_SELECTED_PROBE, timeout=min(150, max(60, _remaining())))
         if "p50_ms" in vals:
             out["colocated_cpu_p50_ms"] = round(vals["p50_ms"], 3)
     except Exception:
@@ -936,36 +899,14 @@ def _config7_body(shard, sindex):
         "speedup": round(t_loop / t_vec, 1) if t_vec else None,
         "parity": a == b,
     }
-    if pindex is not None:
-        # same wide materialisation with the plane reads on-device
-        t_dev = _time_batch(
-            lambda: materialize_response(
-                shard, rows, payload, plane_index=pindex, **kw
-            ),
-            repeats=3,
-        )
-        d = materialize_response(
-            shard, rows, payload, plane_index=pindex, **kw
-        )
-        out["materialize_1e4_rows"]["device_ms"] = round(t_dev * 1e3, 2)
-        out["materialize_1e4_rows"]["device_parity"] = d == b
-
-        # device-only time for one 1024-row masked plane reduction
-        # (popcounts + sample-hit OR), chain-differenced
-        from sbeacon_tpu.ops.plane_kernel import sample_mask_words
-
-        sel_idx = [names.index(sn) for sn in set(selected)]
-        mask_words = sample_mask_words(sel_idx, pindex.n_words)
-        probe_rows = rows[:1024].astype(np.int32)
-        # warm the stats path the p50 queries use, then probe
-        plane_row_stats(pindex, probe_rows, mask_words)
-        try:
-            per = device_plane_probe(
-                pindex, probe_rows, mask_words, iters=96
-            )
-            out["device_plane_us_per_1024_rows"] = round(per * 1e6, 2)
-        except Exception:
-            traceback.print_exc(file=sys.stderr)
+    # the standalone plane-dispatch probes (device materialisation +
+    # device_plane_us_per_1024_rows) are retired with the two-dispatch
+    # path itself: serving answers the selected-samples leaf in the ONE
+    # fused program measured above, and each probe's chain-length
+    # escalation recompiles a multi-thousand-step scan on the tunnel
+    # (minutes per compile) — the r5 run-2 budget killer. The plane
+    # kernel remains the mesh/overflow fallback, parity-tested in
+    # tests/test_plane_kernel.py.
     return out
 
 
@@ -1285,7 +1226,7 @@ def main() -> None:
     run("config4_multi_dataset", 170, config4_multi_dataset)
     run("config5_sv_indel", 60, lambda: config5_sv_indel(shard, sindex))
     run("config6_ingest", 90, config6_ingest)
-    run("config7_selected_samples", 160, config7_selected_samples)
+    run("config7_selected_samples", 230, config7_selected_samples)
     run("config8_skew", 80, config8_skew)
     run("config9_soak", 120, lambda: config9_soak(shard, sindex))
     emit(final=True)
