@@ -36,11 +36,48 @@ def specs_for(shard, n):
 
 
 def test_batch_tiers_pad_and_trim():
-    """run_queries pads to fixed BATCH_TIERS and trims outputs — the
-    shape-bucketing the batcher used to pre-do (now one place only)."""
-    from sbeacon_tpu.ops.kernel import BATCH_TIERS
+    """run_queries pads to fixed BATCH_TIERS (repeating query 0) and
+    trims every output back to the logical batch — the shape-bucketing
+    the batcher used to pre-do (now one place only)."""
+    import random
+
+    from sbeacon_tpu.index import build_index
+    from sbeacon_tpu.ops import DeviceIndex
+    from sbeacon_tpu.ops.kernel import (
+        BATCH_TIERS,
+        QuerySpec,
+        run_queries,
+    )
+    from sbeacon_tpu.testing import random_records
 
     assert BATCH_TIERS == (8, 64, 512, 2048)
+    rng = random.Random(3)
+    recs = random_records(rng, chrom="1", n=200, n_samples=4)
+    shard = build_index(recs, dataset_id="bt")
+    dindex = DeviceIndex(shard, pad_unit=1024)
+    pos = shard.cols["pos"]
+    specs = [
+        QuerySpec(
+            "1",
+            int(pos[rng.randrange(shard.n_rows)]),
+            int(pos[rng.randrange(shard.n_rows)]) + 200,
+            1,
+            1 << 30,
+            alternate_bases="N",
+        )
+        for _ in range(11)  # pads to the 64 tier
+    ]
+    got = run_queries(dindex, specs, window_cap=256, record_cap=32)
+    assert len(got.exists) == 11  # trimmed, not tier-sized
+    # per-query answers must be independent of tier padding: compare
+    # against each query answered alone (pads to the 8 tier)
+    for i, s in enumerate(specs):
+        one = run_queries(dindex, [s], window_cap=256, record_cap=32)
+        assert bool(one.exists[0]) == bool(got.exists[i])
+        assert int(one.call_count[0]) == int(got.call_count[i])
+        assert int(one.all_alleles_count[0]) == int(
+            got.all_alleles_count[i]
+        )
 
 
 def test_single_submit_matches_direct(dindex):
