@@ -133,6 +133,9 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
 
     config = BeaconConfig.from_env(args.data_root)
+    from ..config import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(config.storage.root)
     engine = None
     if args.worker:
         from ..engine import VariantEngine

@@ -273,3 +273,26 @@ class BeaconConfig:
         d = dataclasses.asdict(self)
         d["storage"]["root"] = str(d["storage"]["root"])
         return json.dumps(d, indent=2)
+
+
+def enable_persistent_compile_cache(storage_root) -> None:
+    """Point XLA's persistent compilation cache under the storage root:
+    the warmed kernel programs (2-3 min of tunnel compiles on a cold
+    chip) compile once per index/config shape EVER, not once per
+    process start. Shared by BOTH deployment entries — the coordinator
+    (api.server) and the worker host (parallel.dispatch) — so a worker
+    container restart doesn't re-pay the compiles either. Best-effort:
+    the cache is an optimisation, never a dependency."""
+    import logging
+    from pathlib import Path
+
+    try:
+        import jax
+
+        cache_dir = Path(storage_root) / "jax-cache"
+        cache_dir.mkdir(parents=True, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", str(cache_dir))
+    except Exception:
+        logging.getLogger(__name__).exception(
+            "persistent compilation cache unavailable"
+        )

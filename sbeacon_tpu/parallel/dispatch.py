@@ -688,10 +688,16 @@ def main(argv: list[str] | None = None) -> None:
     args = p.parse_args(argv)
 
     config = BeaconConfig.from_env(args.data_root)
+    from ..config import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache(config.storage.root)
     token = args.token if args.token is not None else config.auth.worker_token
     engine = VariantEngine(config)
     service = IngestService(config, engine=engine)
     n = service.load_all()
+    # pre-compile every dispatchable program (first requests must not
+    # pay cold compiles; near-free on restart with the persistent cache)
+    n_warm = engine.warmup()
     worker = WorkerServer(
         engine,
         host=args.host,
@@ -702,7 +708,8 @@ def main(argv: list[str] | None = None) -> None:
     )
     print(
         f"worker serving on {args.host}:{args.port} ({n} shards, "
-        f"datasets: {', '.join(engine.datasets()) or 'none'})"
+        f"datasets: {', '.join(engine.datasets()) or 'none'}, "
+        f"{n_warm} kernel programs warmed)"
     )
     try:
         worker.server.serve_forever()
