@@ -306,3 +306,40 @@ def test_concurrent_soak_batches_requests(tmp_path):
     assert b["launches"] < b["submits"]
     assert b["mean_batch"] > 1.0
     server.shutdown()
+
+
+def test_engine_warmup_compiles_all_paths():
+    """warmup() touches the scatter tiers, fused-plane programs, XLA
+    batch tiers, and mesh pjit programs without error, and
+    DistributedEngine delegates to its local engine."""
+    import random
+
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.index import build_index
+    from sbeacon_tpu.ops.plane_kernel import PlaneDeviceIndex
+    from sbeacon_tpu.ops.scatter_kernel import ScatterDeviceIndex
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine
+    from sbeacon_tpu.testing import random_records
+
+    eng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False))
+    )
+    for d in range(2):
+        rng = random.Random(40 + d)
+        recs = random_records(rng, chrom="7", n=120, n_samples=4)
+        shard = build_index(
+            recs, dataset_id=f"w{d}", sample_names=[f"S{i}" for i in range(4)]
+        )
+        eng.add_prebuilt_index(
+            shard, ScatterDeviceIndex(shard), planes=PlaneDeviceIndex(shard)
+        )
+    n = eng.warmup()
+    # scatter tiers x exact x shapes + fused programs per shard + mesh
+    assert n >= 10, n
+    # repeat is cheap and idempotent
+    assert eng.warmup() == n
+    dist = DistributedEngine([], local=eng)
+    assert dist.warmup() == n
+    dist.close()
+    eng.close()
