@@ -1163,6 +1163,13 @@ def main() -> None:
     # stage, and record (not raise) a corpus/upload failure
     emit()
     try:
+        # persistent XLA compile cache beside the corpus cache: tunnel
+        # compiles (~30-40 s each; config9's 16-program warmup alone was
+        # 158 s cold) are paid once per workspace, not once per run
+        from sbeacon_tpu.config import enable_persistent_compile_cache
+        from sbeacon_tpu.harness.bench_cache import default_cache_root
+
+        enable_persistent_compile_cache(default_cache_root())
         shard, build_s, load_s = build_corpus()
         from sbeacon_tpu.ops.scatter_kernel import ScatterDeviceIndex
 
