@@ -1138,25 +1138,47 @@ def main() -> None:
 
     def emit(final: bool = False) -> None:
         """Re-print the full cumulative record (VERDICT r4 weak #1: a
-        timeout must still leave the last complete line parseable)."""
+        timeout must still leave the last complete line parseable).
+
+        The final emission additionally persists the full record to
+        ``BENCH_final.json`` and ends with a SHORT summary line: the
+        cumulative record is one multi-KB JSON line that overran the
+        driver's log tail window two rounds running (``parsed: null``,
+        VERDICT r5) — the last line of a completed run must be small
+        enough that no tail window can cut it."""
         detail["bench_wall_s"] = round(time.monotonic() - _T_START, 1)
         detail["partial"] = not final
-        print(
-            json.dumps(
-                {
-                    "metric": (
-                        "batched_point_queries_single_chip_20M_rows"
-                    ),
-                    "value": round(headline["qps"], 1),
-                    "unit": "queries/sec",
-                    "vs_baseline": round(
-                        headline["qps"] / BASELINE_QPS, 2
-                    ),
-                    "detail": detail,
-                }
-            ),
-            flush=True,
-        )
+        record = {
+            "metric": "batched_point_queries_single_chip_20M_rows",
+            "value": round(headline["qps"], 1),
+            "unit": "queries/sec",
+            "vs_baseline": round(headline["qps"] / BASELINE_QPS, 2),
+            "detail": detail,
+        }
+        print(json.dumps(record), flush=True)
+        if final:
+            from pathlib import Path
+
+            out_path = Path(__file__).resolve().parent / "BENCH_final.json"
+            try:
+                out_path.write_text(json.dumps(record, indent=2) + "\n")
+                detail_file = out_path.name
+            except OSError:
+                traceback.print_exc(file=sys.stderr)
+                detail_file = None
+            print(
+                json.dumps(
+                    {
+                        "metric": record["metric"],
+                        "value": record["value"],
+                        "unit": record["unit"],
+                        "vs_baseline": record["vs_baseline"],
+                        "partial": False,
+                        "detail_file": detail_file,
+                    }
+                ),
+                flush=True,
+            )
 
     # the preamble itself must not reproduce the rc:124-with-no-output
     # failure: emit a parseable record FIRST and again after every

@@ -19,15 +19,24 @@ tests, batch drivers) is external.
 from __future__ import annotations
 
 import hmac
+import math
 from pathlib import Path
 
 from ..config import BeaconConfig, StorageConfig
 from ..engine import VariantEngine
 from ..ingest import IngestService
 from ..ingest.service import VcfLocationError
+from ..harness import faults
 from ..metadata import MetadataStore, OntologyStore
 from ..metadata.filters import FilterError
 from ..query_jobs import AsyncQueryRunner, QueryJobTable
+from ..resilience import (
+    NO_DEADLINE,
+    AdmissionController,
+    Deadline,
+    ResilienceError,
+    deadline_scope,
+)
 from ..utils.trace import span, tracer
 from .envelopes import Envelopes
 from .framework import (
@@ -81,11 +90,17 @@ def strip_private(doc: dict) -> dict:
     return {k: v for k, v in doc.items() if not k.startswith("_")}
 
 
-def _authorization_header(headers: dict) -> str:
+def _header(headers: dict | None, name: str) -> str | None:
+    """Case-insensitive single-header lookup over a plain dict."""
+    name = name.lower()
     for k, v in (headers or {}).items():
-        if k.lower() == "authorization":
+        if k.lower() == name:
             return v
-    return ""
+    return None
+
+
+def _authorization_header(headers: dict) -> str:
+    return _header(headers, "authorization") or ""
 
 
 def bearer_token_verifier(token: str):
@@ -180,6 +195,16 @@ class BeaconApp:
             inline_limit=self.config.engine.max_response_inline_bytes,
         )
         self.query_runner = AsyncQueryRunner(self.engine, self.query_jobs)
+        # resilience envelope (resilience.py): bounded in-flight
+        # admission + request deadlines; /health, /ready and /metrics
+        # bypass it so probes answer while the server is saturated
+        res = self.config.resilience
+        self.admission = AdmissionController(
+            res.max_in_flight, retry_after_s=res.shed_retry_after_s
+        )
+        # readiness flag: constructed apps are servable; a deployment
+        # may clear it during reload/drain so load balancers back off
+        self.ready = True
         # mutating-route auth (reference /submit is AWS_IAM-gated,
         # api.tf:120-149): explicit verifier > config token > open (dev)
         if auth_verifier is not None:
@@ -190,6 +215,14 @@ class BeaconApp:
             )
         else:
             self.auth_verifier = None
+
+    def close(self) -> None:
+        """Release app-owned resources: the async runner's worker pool
+        and the job table. The engine is NOT closed here — it may be
+        caller-owned and shared (pass-in wiring); call engine.close()
+        separately when this app owns it."""
+        self.query_runner.close()
+        self.query_jobs.close()
 
     # -- transport-facing entry --------------------------------------------
 
@@ -203,14 +236,101 @@ class BeaconApp:
     ) -> tuple[int, dict]:
         try:
             with span("api.handle", path=path, method=method):
+                head = path.strip("/")
+                if method.upper() == "GET" and head in (
+                    "health",
+                    "ready",
+                    "metrics",
+                ):
+                    # probes/metrics bypass auth, admission AND
+                    # deadlines: they must answer while the server is
+                    # saturated or shedding — that is their whole job
+                    return self._probe(head)
                 denied = self._check_auth(method.upper(), path, headers)
                 if denied is not None:
                     return denied
-                return self._route(method.upper(), path, query_params, body)
+                deadline = self._request_deadline(head, headers)
+                with self.admission.admit(), deadline_scope(deadline):
+                    return self._route(
+                        method.upper(), path, query_params, body
+                    )
+        except ResilienceError as e:
+            # 429 shed / 503 batch-timeout & circuit-open / 504 deadline
+            payload = self.env.error(e.status, str(e))
+            if e.retry_after_s is not None:
+                payload["retryAfterSeconds"] = e.retry_after_s
+            return e.status, payload
+        except TimeoutError as e:
+            return 504, self.env.error(504, str(e))
         except (RequestError, FilterError, VcfLocationError) as e:
             return 400, self.env.error(400, str(e))
         except Exception as e:  # pragma: no cover - defensive 500
             return 500, self.env.error(500, f"{type(e).__name__}: {e}")
+
+    def _request_deadline(self, head: str, headers: dict | None) -> Deadline:
+        """The request's deadline: ``X-Beacon-Deadline`` header
+        (seconds) when sent, else the config default — except for
+        ``/submit``, where bulk ingest is a batch job and only an
+        explicit header bounds it."""
+        raw = _header(headers, "x-beacon-deadline")
+        if raw is not None:
+            try:
+                seconds = float(raw)
+                # NaN slips through every <=0 guard (all comparisons
+                # false) and would poison downstream clamps with a
+                # deadline that is never expired yet has 0 remaining;
+                # inf and <=0 are equally meaningless as bounds — and
+                # <=0 must NOT silently disable the operator's default
+                # (Deadline.after semantics), so all three reject
+                if not math.isfinite(seconds) or seconds <= 0:
+                    raise ValueError(raw)
+                return Deadline.after(seconds)
+            except (TypeError, ValueError):
+                raise RequestError(
+                    f"invalid X-Beacon-Deadline header: {raw!r}"
+                    " (want a finite number of seconds > 0)"
+                ) from None
+        if head == "submit":
+            return NO_DEADLINE
+        return Deadline.after(self.config.resilience.default_deadline_s)
+
+    def _probe(self, head: str) -> tuple[int, dict]:
+        info = self.config.info
+        if head == "health":
+            # liveness: cheap, no store/engine access
+            return 200, {"ok": True, "beaconId": info.beacon_id}
+        if head == "ready":
+            # readiness: local state only — never a worker round-trip
+            # (a probe that can hang is worse than no probe)
+            local = getattr(self.engine, "local", None) or self.engine
+            body = {
+                "ready": bool(self.ready),
+                "beaconId": info.beacon_id,
+                "shards": len(getattr(local, "_indexes", {})),
+                "inFlight": self.admission.metrics()["in_flight"],
+            }
+            return (200 if self.ready else 503), body
+        return 200, self._metrics()
+
+    def _metrics(self) -> dict:
+        """Resilience observability: admission, runner pool, batcher
+        occupancy, per-worker breaker states, armed fault plan."""
+        out: dict = {
+            "admission": self.admission.metrics(),
+            "runner": self.query_runner.metrics(),
+        }
+        batcher = getattr(self.engine, "_batcher", None) or getattr(
+            getattr(self.engine, "local", None), "_batcher", None
+        )
+        if batcher is not None:
+            out["batcher"] = batcher.occupancy()
+        breaker = getattr(self.engine, "breaker", None)
+        if breaker is not None:
+            out["breaker"] = breaker.metrics()
+        injector = faults.installed()
+        if injector is not None:
+            out["faults"] = injector.stats()
+        return out
 
     def _check_auth(self, method, path, headers) -> tuple[int, dict] | None:
         """401/403 envelope for unauthorized mutating requests, else None.
@@ -241,10 +361,9 @@ class BeaconApp:
         if not parts or parts == ["info"]:
             return 200, info_response(info)
         head = parts[0]
-        if head == "health" and len(parts) == 1:
-            # liveness probe (compose/k8s healthchecks; workers expose the
-            # same path): cheap, no store/engine access
-            return 200, {"ok": True, "beaconId": info.beacon_id}
+        # NOTE: /health, /ready and /metrics are served in handle()
+        # BEFORE auth/admission/deadline — probes must answer while the
+        # server sheds; they never reach this router
         if head == "schemas":
             # served per-entity default model schemas (the reference
             # vendors these as shared_resources/schemas/ JSON documents;

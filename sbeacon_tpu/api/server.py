@@ -9,6 +9,7 @@ URL + query string + JSON body in, JSON out, CORS header kept
 from __future__ import annotations
 
 import json
+import math
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -50,6 +51,17 @@ def _make_handler(app: BeaconApp):
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             self.send_header("Access-Control-Allow-Origin", "*")
+            retry_after = (
+                payload.get("retryAfterSeconds")
+                if isinstance(payload, dict) and status in (429, 503)
+                else None
+            )
+            if retry_after is not None:
+                # standard client-backoff hint alongside the envelope
+                # field (integral seconds per RFC 9110, rounded up)
+                self.send_header(
+                    "Retry-After", str(max(1, math.ceil(retry_after)))
+                )
             self.send_header("Content-Length", str(len(data)))
             self.end_headers()
             self.wfile.write(data)
@@ -94,6 +106,10 @@ def serve(app: BeaconApp, host: str = "0.0.0.0", port: int = 5000):
         server.serve_forever()
     finally:
         server.server_close()
+        # app-owned pools/tables die with the deployment entry (the
+        # runner's worker threads are non-daemon; leaving them alive
+        # stalls interpreter exit on the atexit join)
+        app.close()
 
 
 def start_background(app: BeaconApp, host: str = "127.0.0.1", port: int = 0):
@@ -134,8 +150,12 @@ def main(argv: list[str] | None = None) -> None:
 
     config = BeaconConfig.from_env(args.data_root)
     from ..config import enable_persistent_compile_cache
+    from ..harness.faults import install_from_env
 
     enable_persistent_compile_cache(config.storage.root)
+    # chaos runs against a real server: BEACON_FAULT_PLAN arms seeded
+    # fault injection (harness/faults.py); unset = no-op
+    install_from_env()
     engine = None
     if args.worker:
         from ..engine import VariantEngine

@@ -202,6 +202,7 @@ def run_variant_search(
     )
     if runner is not None:
         from ..query_jobs import JobStatus
+        from ..resilience import current_deadline
 
         query_id, _ = runner.submit(
             payload, fingerprint=engine.index_fingerprint()
@@ -210,6 +211,10 @@ def run_variant_search(
             query_id, wait_s=engine.config.engine.request_timeout_s
         )
         if responses is None:
+            # the result wait is deadline-clamped: distinguish "the
+            # request ran out of time" (504, retryable with a longer
+            # deadline) from "the engine exceeded request_timeout_s"
+            current_deadline().check("variant query")
             if runner.poll(query_id) is JobStatus.RUNNING:
                 # still executing past request_timeout_s: starting a second
                 # identical search would double device load exactly when

@@ -176,6 +176,38 @@ class ResolverConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ResilienceConfig:
+    """Failure envelope (resilience.py) — the knobs the reference got
+    from the platform tier: API Gateway's 29 s hard timeout ->
+    ``default_deadline_s``; Lambda reserved concurrency / API-GW
+    throttling -> ``max_in_flight``; invoke retry + backoff ->
+    the circuit breaker triple.
+
+    default_deadline_s: request deadline when the client sends no
+      ``X-Beacon-Deadline`` header; 0 disables. Ingest (``/submit``)
+      is exempt from the *default* — a bulk VCF scan is a batch job,
+      not a request — but an explicit header still applies there.
+    batch_timeout_s: micro-batch submit bound — even deadline-less
+      callers cannot block on a wedged kernel launch forever.
+    max_in_flight: admission cap; excess requests answer 429 +
+      Retry-After instead of queueing.
+    runner_workers / runner_max_pending: the async query runner's
+      bounded pool (replaces thread-per-query) and its shed threshold.
+    breaker_*: consecutive-failure circuit breaker on per-worker routes.
+    """
+
+    default_deadline_s: float = 60.0
+    batch_timeout_s: float = 60.0
+    max_in_flight: int = 256
+    shed_retry_after_s: float = 1.0
+    runner_workers: int = 8
+    runner_max_pending: int = 64
+    breaker_failure_threshold: int = 5
+    breaker_reset_s: float = 30.0
+    breaker_half_open_probes: int = 1
+
+
+@dataclasses.dataclass(frozen=True)
 class AuthConfig:
     """Authentication for the two trust boundaries the reference gates
     with IAM: the mutating ``/submit`` route (reference: api.tf:120-149,
@@ -204,6 +236,9 @@ class BeaconConfig:
         default_factory=ResolverConfig
     )
     auth: AuthConfig = dataclasses.field(default_factory=AuthConfig)
+    resilience: ResilienceConfig = dataclasses.field(
+        default_factory=ResilienceConfig
+    )
 
     @staticmethod
     def from_env(root: str | os.PathLike | None = None) -> "BeaconConfig":
@@ -260,6 +295,22 @@ class BeaconConfig:
             submit_token=env.get("BEACON_SUBMIT_TOKEN", ""),
             worker_token=env.get("BEACON_WORKER_TOKEN", ""),
         )
+        res_over: dict = {}
+        _res_env = {
+            "BEACON_DEADLINE_S": ("default_deadline_s", float),
+            "BEACON_BATCH_TIMEOUT_S": ("batch_timeout_s", float),
+            "BEACON_MAX_IN_FLIGHT": ("max_in_flight", int),
+            "BEACON_SHED_RETRY_AFTER_S": ("shed_retry_after_s", float),
+            "BEACON_RUNNER_WORKERS": ("runner_workers", int),
+            "BEACON_RUNNER_MAX_PENDING": ("runner_max_pending", int),
+            "BEACON_BREAKER_THRESHOLD": ("breaker_failure_threshold", int),
+            "BEACON_BREAKER_RESET_S": ("breaker_reset_s", float),
+            "BEACON_BREAKER_PROBES": ("breaker_half_open_probes", int),
+        }
+        for var, (field, conv) in _res_env.items():
+            if var in env:
+                res_over[field] = conv(env[var])
+        resilience = ResilienceConfig(**res_over)
         return BeaconConfig(
             info=info,
             storage=storage,
@@ -267,6 +318,7 @@ class BeaconConfig:
             ingest=ingest,
             resolvers=resolvers,
             auth=auth,
+            resilience=resilience,
         )
 
     def dumps(self) -> str:

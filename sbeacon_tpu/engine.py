@@ -614,9 +614,11 @@ class VariantEngine:
         if eng.microbatch:
             from .serving import MicroBatcher
 
+            res = getattr(self.config, "resilience", None)
             self._batcher = MicroBatcher(
                 max_batch=eng.microbatch_max,
                 max_wait_ms=eng.microbatch_wait_ms,
+                default_timeout_s=getattr(res, "batch_timeout_s", None),
             )
         else:
             self._batcher = None
@@ -855,6 +857,8 @@ class VariantEngine:
         """Release the scatter pool (same contract as
         DistributedEngine.close)."""
         self._scatter.shutdown(wait=False, cancel_futures=True)
+        if self._batcher is not None:
+            self._batcher.close()
 
     def datasets(self) -> list[str]:
         return sorted({ds for ds, _ in self._indexes})
@@ -906,6 +910,9 @@ class VariantEngine:
                 record_cap=eng.record_cap,
             )
         else:
+            from .harness.faults import fault_point
+
+            fault_point("kernel.launch")
             res = run_queries_auto(
                 dindex,
                 [spec],

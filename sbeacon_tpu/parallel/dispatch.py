@@ -34,10 +34,17 @@ import concurrent.futures as futures_mod
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+from ..harness.faults import fault_point
 from ..payloads import (
     SliceScanPayload,
     VariantQueryPayload,
     VariantSearchResponse,
+)
+from ..resilience import (
+    CircuitBreaker,
+    CircuitOpen,
+    DeadlineExceeded,
+    current_deadline,
 )
 from ..utils.trace import span
 
@@ -269,7 +276,8 @@ class ScanWorkerPool:
     falls back to scanning locally — a missing worker degrades
     throughput, never correctness (reference analogue: a failed
     summariseSlice lambda's slice stays in the toUpdate set and is
-    re-run). A worker that fails is put on a cooldown so one wedged host
+    re-run). A worker that fails trips its circuit (one-strike breaker:
+    open for ``cooldown_s``, then a half-open probe) so one wedged host
     cannot stall every slice for a full timeout each (the dead-worker
     exclusion the query-path scatter already has via discovery refresh).
     """
@@ -293,26 +301,31 @@ class ScanWorkerPool:
         self.cooldown_s = cooldown_s
         self._post_bytes = post_bytes
         self._next = 0
-        self._dead_until: dict[str, float] = {}
+        # the round-4 ad-hoc _dead_until cooldown map, generalised: a
+        # single failure opens the circuit for cooldown_s (scan slices
+        # have a local fallback, so one strike is the right threshold),
+        # then a half-open probe readmits the worker on success
+        self.breaker = CircuitBreaker(
+            failure_threshold=1, reset_timeout_s=cooldown_s
+        )
         self._lock = threading.Lock()
 
     def _pick(self) -> str:
         with self._lock:
-            now = time.monotonic()
             for _ in range(len(self.worker_urls)):
                 url = self.worker_urls[self._next % len(self.worker_urls)]
                 self._next += 1
-                if self._dead_until.get(url, 0.0) <= now:
+                if self.breaker.allow(url):
                     return url
-            # every worker is cooling down: take the next anyway (it may
-            # have recovered; correctness is covered by local fallback)
+            # every worker's circuit is open: take the next anyway (it
+            # may have recovered; correctness is covered by local
+            # fallback)
             url = self.worker_urls[self._next % len(self.worker_urls)]
             self._next += 1
             return url
 
     def _mark_dead(self, url: str) -> None:
-        with self._lock:
-            self._dead_until[url] = time.monotonic() + self.cooldown_s
+        self.breaker.record_failure(url)
 
     def _auth_headers(self) -> dict | None:
         return (
@@ -336,10 +349,18 @@ class ScanWorkerPool:
                 self._mark_dead(url)
                 continue
             if status == 200:
+                self.breaker.record_success(url)
                 return body
             last = WorkerError(f"{url}: http {status}: {body[:200]!r}")
             if status in (401, 403):
                 self._mark_dead(url)
+            else:
+                # any other HTTP answer proves the worker is ALIVE
+                # (the breaker tracks reachability, not scan success —
+                # scan errors are handled by retry + local fallback);
+                # recording an outcome also releases a half-open probe
+                # so a 500-answering worker is not excluded forever
+                self.breaker.record_success(url)
         raise last
 
     def scan(self, payload: SliceScanPayload):
@@ -417,6 +438,7 @@ class DistributedEngine:
         post=urllib_post,
         get=urllib_get,
         token: str = "",
+        breaker: CircuitBreaker | None = None,
     ):
         from ..config import BeaconConfig
 
@@ -436,6 +458,19 @@ class DistributedEngine:
         # `config` param would silently drop a token that arrived via
         # local.config.auth.worker_token
         self._token = token or self.config.auth.worker_token
+        # per-worker circuit breaker (reference analogue: the invoke
+        # retry/backoff AWS applies per lambda): consecutive /search
+        # failures open the route, calls fast-fail instead of eating the
+        # full timeout each, and a half-open probe readmits the worker.
+        # Injectable for tests (fake clock drives transitions).
+        res = getattr(self.config, "resilience", None)
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=getattr(
+                res, "breaker_failure_threshold", 5
+            ),
+            reset_timeout_s=getattr(res, "breaker_reset_s", 30.0),
+            half_open_probes=getattr(res, "breaker_half_open_probes", 1),
+        )
         self._routes_lock = threading.Lock()
         self._routes: dict[str, str] | None = None  # dataset -> worker url
         self._fingerprints: dict[str, str] = {}
@@ -549,18 +584,34 @@ class DistributedEngine:
 
     # -- query path ---------------------------------------------------------
 
-    def _call_worker(self, url: str, payload: VariantQueryPayload):
+    def _call_worker(
+        self, url: str, payload: VariantQueryPayload, deadline=None
+    ):
+        if not self.breaker.allow(url):
+            # fast-fail: the route failed repeatedly and its reset
+            # window hasn't lapsed — don't spend timeout_s finding out
+            raise CircuitOpen(f"worker {url}: circuit open")
         doc = json.loads(payload.dumps())
+        # the request deadline is passed EXPLICITLY by search(): this
+        # runs on a pool thread, where the submitting request's
+        # thread-local scope is not visible
+        if deadline is None:
+            deadline = current_deadline()
         last = None
         for attempt in range(self.retries + 1):
+            timeout_s = deadline.clamp(self.timeout_s)
+            if timeout_s is not None and timeout_s <= 0:
+                deadline.check(f"worker {url} call")
             try:
+                fault_point("worker.http", url)
                 status, out = self._post_auth(
-                    f"{url}/search", doc, self.timeout_s
+                    f"{url}/search", doc, timeout_s
                 )
             except Exception as e:
                 last = WorkerError(f"{url}: {e}")
             else:
                 if status == 200:
+                    self.breaker.record_success(url)
                     return [
                         VariantSearchResponse(**r)
                         for r in out.get("responses", [])
@@ -570,6 +621,15 @@ class DistributedEngine:
                 )
             if attempt < self.retries:  # no dead sleep after final try
                 time.sleep(min(0.05 * (attempt + 1), 1.0))
+        if deadline.expired():
+            # the REQUEST ran out of time, not the worker out of
+            # health: a deadline-clamped timeout must not count against
+            # the route (tight-deadline traffic would open the circuit
+            # on a perfectly healthy worker and 503 everyone else)
+            raise DeadlineExceeded(
+                f"worker {url}: request deadline expired"
+            ) from last
+        self.breaker.record_failure(url)
         raise last
 
     def search(
@@ -578,6 +638,7 @@ class DistributedEngine:
         import dataclasses
 
         with span("dispatch.search") as sp:
+            current_deadline().check("dispatch.search")
             routes = self.routes()
             wanted = payload.dataset_ids or self.datasets()
             local_ds = (
@@ -609,14 +670,28 @@ class DistributedEngine:
                 # await every future before raising: a fast-failing
                 # worker must not strand slow siblings' tasks in the
                 # shared pool (they'd hold threads for up to timeout_s
-                # and starve concurrent searches)
+                # and starve concurrent searches). The drain itself is
+                # deadline-bounded: a hung worker call must not hold
+                # THIS thread past the request's deadline — on expiry
+                # the still-running futures are left to finish on the
+                # pool (bounded by their own clamped urllib timeouts)
+                # and the caller gets DeadlineExceeded now.
+                deadline = current_deadline()
                 futures = [
-                    self._pool.submit(self._call_worker, *t) for t in tasks
+                    self._pool.submit(self._call_worker, *t, deadline)
+                    for t in tasks
                 ]
                 first_err: BaseException | None = None
                 for f in futures:
                     try:
-                        responses.extend(f.result())
+                        responses.extend(
+                            f.result(timeout=deadline.remaining())
+                        )
+                    except futures_mod.TimeoutError:
+                        if first_err is None:
+                            first_err = DeadlineExceeded(
+                                "worker fan-in: deadline exceeded"
+                            )
                     except (Exception, futures_mod.CancelledError) as e:
                         # CancelledError (close() mid-search) is a
                         # BaseException: it must not abort the drain
@@ -689,8 +764,11 @@ def main(argv: list[str] | None = None) -> None:
 
     config = BeaconConfig.from_env(args.data_root)
     from ..config import enable_persistent_compile_cache
+    from ..harness.faults import install_from_env
 
     enable_persistent_compile_cache(config.storage.root)
+    # worker-side chaos: BEACON_FAULT_PLAN arms seeded fault injection
+    install_from_env()
     token = args.token if args.token is not None else config.auth.worker_token
     engine = VariantEngine(config)
     service = IngestService(config, engine=engine)

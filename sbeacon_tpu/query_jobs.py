@@ -34,7 +34,16 @@ import uuid
 from enum import Enum
 from pathlib import Path
 
+from concurrent.futures import ThreadPoolExecutor
+
+from .harness.faults import fault_point
 from .payloads import VariantSearchResponse
+from .resilience import (
+    AdmissionController,
+    Overloaded,
+    current_deadline,
+    deadline_scope,
+)
 from .utils.trace import span
 
 
@@ -246,6 +255,7 @@ class QueryJobTable:
             spill_path = str(self.spill_dir / f"{uuid.uuid4()}.json")
             Path(spill_path).write_text(body)
             body = None
+        fault_point("sqlite.commit", "put_response")
         now = time.time()
         with self._lock, self._conn:
             if not self._owns(query_id, claim):
@@ -286,6 +296,7 @@ class QueryJobTable:
         return int(remaining)
 
     def complete(self, query_id: str, claim: str) -> bool:
+        fault_point("sqlite.commit", "complete")
         now = time.time()
         with self._lock, self._conn:
             if not self._owns(query_id, claim):
@@ -321,7 +332,10 @@ class QueryJobTable:
 
     def wait(self, query_id: str, timeout_s: float = 600.0) -> bool:
         """Poll fan_out==0 / complete — the reference's fan-in loop
-        (variantutils/search_variants.py:130-141), REQUEST_TIMEOUT 600 s."""
+        (variantutils/search_variants.py:130-141), REQUEST_TIMEOUT 600 s.
+        Clamped by the caller's ambient request deadline: a 600 s poll
+        budget never outlives the request it serves."""
+        timeout_s = current_deadline().clamp(timeout_s)
         deadline = time.time() + timeout_s
         delay = 0.002
         while time.time() < deadline:
@@ -423,10 +437,42 @@ class AsyncQueryRunner:
     #: seconds between opportunistic TTL sweeps piggybacked on submit()
     PURGE_INTERVAL_S = 60.0
 
-    def __init__(self, engine, table: QueryJobTable):
+    def __init__(
+        self,
+        engine,
+        table: QueryJobTable,
+        *,
+        workers: int | None = None,
+        max_pending: int | None = None,
+    ):
         self.engine = engine
         self.table = table
-        self._threads: dict[str, threading.Thread] = {}
+        res = getattr(
+            getattr(engine, "config", None), "resilience", None
+        )
+        # explicit None checks, not `or`: a configured 0 must fail
+        # loudly (ThreadPoolExecutor / AdmissionController raise), not
+        # silently coerce to the default
+        if workers is None:
+            workers = getattr(res, "runner_workers", 8)
+        if max_pending is None:
+            max_pending = getattr(res, "runner_max_pending", 64)
+        self.workers = workers
+        self.max_pending = max_pending
+        self.shed_retry_after_s = getattr(res, "shed_retry_after_s", 1.0)
+        # bounded pool, NOT thread-per-query: a flood of distinct
+        # queries used to spawn one unbounded thread each — under
+        # adversarial load that is a fork bomb with extra steps. The
+        # pool bounds concurrency; the admission gate bounds the queue
+        # behind it (excess submissions shed 429, never silently pile
+        # up) — same mechanism as the server-level gate, acquired here
+        # and released from the pool thread.
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix="query-runner"
+        )
+        self._gate = AdmissionController(
+            self.max_pending, retry_after_s=self.shed_retry_after_s
+        )
         # in-process completion events: waiters block on these instead of
         # polling sqlite; cross-process (or post-restart) waiters fall
         # back to the table's poll loop
@@ -436,31 +482,52 @@ class AsyncQueryRunner:
         self._results: dict[str, tuple[list, float]] = {}
         self._lock = threading.Lock()
         self._last_purge = time.time()
+        self._sweeper: threading.Thread | None = None
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    def metrics(self) -> dict:
+        gate = self._gate.metrics()
+        return {
+            "workers": self.workers,
+            "max_pending": self.max_pending,
+            "active": gate["in_flight"],
+            "shed": gate["shed"],
+        }
 
     def _maybe_purge(self) -> None:
         now = time.time()
-        if now - self._last_purge < self.PURGE_INTERVAL_S:
-            return
-        self._last_purge = now
+        with self._lock:
+            if now - self._last_purge < self.PURGE_INTERVAL_S:
+                return
+            # one sweeper at a time: a slow sweep (WAL checkpoint on a
+            # busy disk) must not stack a fresh thread every interval
+            if self._sweeper is not None and self._sweeper.is_alive():
+                self._last_purge = now  # re-check next interval, not
+                return  # on every submit meanwhile
 
-        # the sweep DELETEs + commits — run it off the serving thread
-        # (piggybacked purges used to stall ~1 request per minute by a
-        # full fsync; the r5 soak tail decomposition caught it)
-        def sweep():
-            self.table.purge_expired()
-            self.table.checkpoint()
-            with self._lock:
-                dead = [
-                    q
-                    for q, (_, exp) in self._results.items()
-                    if exp <= now
-                ]
-                for q in dead:
-                    del self._results[q]
+            # the sweep DELETEs + commits — run it off the serving
+            # thread (piggybacked purges used to stall ~1 request per
+            # minute by a full fsync; the r5 soak tail caught it)
+            def sweep():
+                self.table.purge_expired()
+                self.table.checkpoint()
+                with self._lock:
+                    dead = [
+                        q
+                        for q, (_, exp) in self._results.items()
+                        if exp <= now
+                    ]
+                    for q in dead:
+                        del self._results[q]
 
-        threading.Thread(
-            target=sweep, name="query-jobs-purge", daemon=True
-        ).start()
+            self._last_purge = now
+            t = threading.Thread(
+                target=sweep, name="query-jobs-purge", daemon=True
+            )
+            self._sweeper = t
+        t.start()
 
     def submit(
         self, payload, *, fingerprint: str | None = None
@@ -481,9 +548,30 @@ class AsyncQueryRunner:
         status = self.table.get_job_status(query_id)
         if status is JobStatus.COMPLETED:
             return query_id, status
-        claim = self.table.start(query_id, fan_out=1)
+        if status is JobStatus.RUNNING:
+            # coalesce onto the in-flight execution — consumes no pool
+            # slot, so it must happen before the capacity gate
+            return query_id, status
+        # reserve a pool slot BEFORE claiming: shedding after a claim
+        # would leave the job RUNNING with nobody executing it, stalling
+        # coalesced waiters for the full TTL. Coalescing onto an
+        # existing claim consumes no slot and is never shed.
+        if not self._gate.try_acquire():
+            raise Overloaded(
+                f"query runner at capacity ({self.max_pending} pending)",
+                retry_after_s=self.shed_retry_after_s,
+            )
+        try:
+            claim = self.table.start(query_id, fan_out=1)
+        except BaseException:
+            # a failed claim (sqlite locked, disk full) must release
+            # the reserved slot, or leaks accumulate until every
+            # submit sheds 429 against an idle pool
+            self._gate.release()
+            raise
         if claim is None:
             # someone else holds an unexpired claim: coalesce
+            self._gate.release()
             return query_id, JobStatus.RUNNING
 
         pl = dataclasses.replace(payload, query_id=query_id)
@@ -491,11 +579,19 @@ class AsyncQueryRunner:
         with self._lock:
             self._done[query_id] = done
             self._results.pop(query_id, None)
+        # the SPAWNING request's deadline rides into the worker thread
+        # (thread-locals don't cross): the search abandons at its next
+        # check-point once the deadline lapses — worker calls clamp,
+        # expired batches refuse to launch. A coalescer with a longer
+        # deadline simply sees the abandoned job and falls back to a
+        # direct search under its own deadline.
+        job_deadline = current_deadline()
 
         def run():
             with span("query_jobs.run", query_id=query_id):
                 try:
-                    responses = self.engine.search(pl)
+                    with deadline_scope(job_deadline):
+                        responses = self.engine.search(pl)
                     with self._lock:
                         self._results[query_id] = (
                             responses,
@@ -526,14 +622,20 @@ class AsyncQueryRunner:
                     self.table.abandon(query_id, claim)
                 finally:
                     done.set()
+                    self._gate.release()
                     with self._lock:
-                        self._threads.pop(query_id, None)
                         self._done.pop(query_id, None)
 
-        t = threading.Thread(target=run, name=f"query-{query_id[:8]}")
-        with self._lock:
-            self._threads[query_id] = t
-        t.start()
+        try:
+            self._pool.submit(run)
+        except RuntimeError:
+            # pool shut down (close() raced a late submit): release
+            # everything so the job doesn't read RUNNING forever
+            self._gate.release()
+            with self._lock:
+                self._done.pop(query_id, None)
+            self.table.abandon(query_id, claim)
+            raise
         return query_id, JobStatus.RUNNING
 
     def poll(self, query_id: str) -> JobStatus:
@@ -542,8 +644,10 @@ class AsyncQueryRunner:
     def result(
         self, query_id: str, *, wait_s: float = 0.0
     ) -> list[VariantSearchResponse] | None:
-        """Responses if COMPLETED (optionally waiting), else None."""
+        """Responses if COMPLETED (optionally waiting), else None.
+        The wait is clamped by the caller's ambient request deadline."""
         if wait_s > 0:
+            wait_s = current_deadline().clamp(wait_s)
             with self._lock:
                 ev = self._done.get(query_id)
             if ev is not None:

@@ -20,6 +20,7 @@ of one per batch size.
 
 from __future__ import annotations
 
+import queue
 import threading
 import time
 import weakref
@@ -28,8 +29,16 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .harness.faults import fault_point
 from .ops import run_queries_auto
 from .ops.kernel import QueryResults, encode_queries
+from .resilience import (
+    NO_DEADLINE,
+    BatchTimeout,
+    Deadline,
+    DeadlineExceeded,
+    current_deadline,
+)
 from .utils.trace import span
 
 
@@ -40,6 +49,11 @@ class _Pending:
     result: object = None
     error: BaseException | None = None
     t_submit: float = 0.0
+    #: combined bound (request deadline ∧ batch timeout) — when waits end
+    deadline: Deadline = NO_DEADLINE
+    #: request deadline alone — decides 504 (request's fault) vs 503
+    #: (server-side wedge) when the combined bound expires
+    req_deadline: Deadline = NO_DEADLINE
 
 
 class _Accumulator:
@@ -51,6 +65,63 @@ class _Accumulator:
         self.leader_active = False
 
 
+class _LaunchPool:
+    """Minimal DAEMON-thread work pool for kernel launches.
+
+    Not a ThreadPoolExecutor: concurrent.futures registers an atexit
+    hook that JOINS its (non-daemon) workers, so a truly wedged launch
+    — the exact failure this layer exists to bound — would block
+    interpreter shutdown forever. Daemon workers let the process exit;
+    the per-task Event gives the leader its bounded wait. Workers are
+    created lazily, one per submit up to ``max_workers``, then reused.
+    """
+
+    def __init__(self, max_workers: int, name: str):
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._max = max_workers
+        self._name = name
+        self._lock = threading.Lock()
+        self._n_threads = 0
+        self._closed = False
+
+    def submit(self, fn, *args) -> threading.Event:
+        """Enqueue fn(*args); returns an Event set when it finishes.
+        Raises after close(): a task enqueued with no workers left
+        would otherwise never run and its Event never fire, turning a
+        shutdown race into a phantom 'wedged device'."""
+        done = threading.Event()
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("launch pool is closed")
+            self._q.put((fn, args, done))
+            if self._n_threads < self._max:
+                self._n_threads += 1
+                threading.Thread(
+                    target=self._worker,
+                    name=f"{self._name}_{self._n_threads}",
+                    daemon=True,
+                ).start()
+        return done
+
+    def _worker(self):
+        while True:
+            item = self._q.get()
+            if item is None:  # close() poison pill
+                return
+            fn, args, done = item
+            try:
+                fn(*args)
+            finally:
+                done.set()
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            n = self._n_threads
+        for _ in range(n):
+            self._q.put(None)
+
+
 class MicroBatcher:
     """Batches kernel launches per device index.
 
@@ -59,9 +130,20 @@ class MicroBatcher:
     returns that query's row of the :class:`QueryResults`.
     """
 
-    def __init__(self, *, max_batch: int = 512, max_wait_ms: float = 2.0):
+    def __init__(
+        self,
+        *,
+        max_batch: int = 512,
+        max_wait_ms: float = 2.0,
+        default_timeout_s: float | None = None,
+    ):
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
+        # upper bound on any submit's wait for its kernel launch: even
+        # a caller with no propagated deadline cannot block forever
+        # behind a wedged launch (the pre-resilience follower hang).
+        # None = unbounded (explicit opt-out, e.g. micro tests).
+        self.default_timeout_s = default_timeout_s
         # occupancy accounting (the soak harness's evidence that
         # batching engages under concurrency): {batch_size: n_launches}
         self._stats_lock = threading.Lock()
@@ -73,6 +155,10 @@ class MicroBatcher:
         # long-lived server cannot grow it unboundedly.
         self._wait_ms: deque = deque(maxlen=65536)
         self._exec_ms: deque = deque(maxlen=65536)
+        # resilience observability: submits that expired before their
+        # launch (leader-side filter) / timed out waiting (follower)
+        self._n_expired = 0
+        self._n_timeouts = 0
         # weak-keyed by the DeviceIndex so accumulators die with their
         # index (re-ingestion replaces DeviceIndex objects; an id()-keyed
         # dict would leak one accumulator per replaced index and could
@@ -81,6 +167,16 @@ class MicroBatcher:
             weakref.WeakKeyDictionary()
         )
         self._lock = threading.Lock()
+        # launches run on this pool, NOT on the leader's own thread, so
+        # the leader's wait for its batch is deadline-bounded like a
+        # follower's: a wedged device strands a (daemon) launcher
+        # thread — which recovers if the launch ever returns and never
+        # blocks process exit — not the request thread and its
+        # admission slot. The leader still BLOCKS on the in-flight
+        # launch before popping the next batch — that serialization is
+        # what makes arrivals accumulate into batches (continuous
+        # batching), so it must not be dispatched away.
+        self._launcher = _LaunchPool(16, "kernel-launch")
 
     def _accum(self, dindex, caps: tuple) -> _Accumulator:
         with self._lock:
@@ -100,13 +196,29 @@ class MicroBatcher:
         *,
         window_cap: int,
         record_cap: int,
+        timeout_s: float | None = None,
     ):
         """Returns (exists, call_count, n_variants, all_alleles_count,
         n_matched, overflow, rows) for this one query — one row of the
-        batched QueryResults."""
+        batched QueryResults.
+
+        The wait is bounded by the tightest of ``timeout_s``, the
+        batcher's ``default_timeout_s``, and the caller thread's ambient
+        request deadline: expiry raises :class:`BatchTimeout` (still
+        queued — no launch happened in time) or
+        :class:`DeadlineExceeded` (the leader filtered this entry as
+        already-expired before launching)."""
         acc = self._accum(dindex, (window_cap, record_cap))
+        req_deadline = current_deadline()
+        deadline = req_deadline.combine(
+            timeout_s if timeout_s is not None else self.default_timeout_s
+        )
         me = _Pending(
-            spec=spec, event=threading.Event(), t_submit=time.perf_counter()
+            spec=spec,
+            event=threading.Event(),
+            t_submit=time.perf_counter(),
+            deadline=deadline,
+            req_deadline=req_deadline,
         )
         with self._stats_lock:
             self._n_submits += 1
@@ -120,21 +232,42 @@ class MicroBatcher:
                 lead = True
 
         if lead:
-            self._lead(acc, dindex, window_cap, record_cap)
+            self._lead(acc, dindex, window_cap, record_cap, me, req_deadline)
         else:
-            me.event.wait()
+            me.event.wait(deadline.remaining())
+            if not me.event.is_set():
+                # still queued: withdraw so an eventual launch doesn't
+                # execute a query nobody is waiting for. Already
+                # dequeued into an in-flight batch: the result (or
+                # error) is coming but past this caller's bound — give
+                # up anyway; the leader's later event.set() lands on a
+                # _Pending nobody reads.
+                with acc.lock:
+                    try:
+                        acc.items.remove(me)
+                    except ValueError:
+                        pass
+                    timed_out = not me.event.is_set()
+                if timed_out:
+                    raise self._timeout_error(req_deadline)
         if me.error is not None:
             raise me.error
         return me.result
 
-    def _lead(self, acc: _Accumulator, dindex, window_cap, record_cap):
-        # The whole leader body runs under try/finally: if the leader dies
-        # with anything _execute doesn't swallow (e.g. KeyboardInterrupt in
-        # the follower-wait window), leadership must not stay claimed —
-        # queued followers wait on event.wait() with no timeout, so a
-        # leaked leader_active=True would hang them and every future
-        # submit to this accumulator.
-        batch: list[_Pending] = []
+    def _lead(
+        self,
+        acc: _Accumulator,
+        dindex,
+        window_cap,
+        record_cap,
+        me: _Pending,
+        req_deadline=NO_DEADLINE,
+    ):
+        # Runs under a broad except: if the leader dies with anything
+        # _execute doesn't swallow (e.g. KeyboardInterrupt in the
+        # follower-wait window), leadership must not stay claimed —
+        # queued followers would wait out their full timeouts, and with
+        # no timeout configured, forever.
         try:
             # wait for followers: batch fills or the window lapses
             sleeper = threading.Event()  # timed wait without busy-looping
@@ -146,8 +279,45 @@ class MicroBatcher:
                         break
                 sleeper.wait(step)
                 waited += step
+            self._serve(acc, dindex, window_cap, record_cap, me, req_deadline)
+        except (BatchTimeout, DeadlineExceeded):
+            raise  # leader's own bound: batch/orphans stay live
+        except BaseException as e:
+            self._fail_queued(acc, e)
+            raise
 
-            while True:
+    def _fail_queued(self, acc: _Accumulator, e: BaseException) -> None:
+        """Release leadership and fail everything still queued — the
+        hard-death cleanup for a serving loop that cannot continue."""
+        with acc.lock:
+            acc.leader_active = False
+            orphans, acc.items = acc.items, []
+        for p in orphans:
+            if not p.event.is_set():
+                p.error = e
+                p.event.set()
+
+    def _serve(
+        self, acc, dindex, window_cap, record_cap, me, req_deadline
+    ) -> None:
+        """The leadership loop: pop batches, filter expired entries,
+        launch, wait bounded. ``me`` is the leading request's own entry
+        (None when run as a background drainer): the moment its answer
+        is in, any remaining backlog is handed to a transient daemon
+        drainer and this request RETURNS — a leader must not keep
+        serving other requests' batches on its own clock (and its own
+        admission slot). The drainer exists only while backlog does, so
+        the zero-idle-cost property of leader election is kept."""
+        while True:
+            if me is not None and me.event.is_set():
+                # our answer is ready: hand off any backlog and return.
+                # Leadership transfer is atomic — the drainer starts
+                # with leader_active still True, so no window exists in
+                # which a new submit would elect a second leader.
+                self._handoff_or_release(acc, dindex, window_cap, record_cap)
+                return
+            batch: list[_Pending] = []
+            try:
                 with acc.lock:
                     batch = acc.items[: self.max_batch]
                     acc.items = acc.items[self.max_batch :]
@@ -156,22 +326,165 @@ class MicroBatcher:
                         acc.leader_active = False
                 if not batch:
                     return
-                self._execute(batch, dindex, window_cap, record_cap)
-                if not more:
-                    return
-        except BaseException as e:
-            with acc.lock:
+                # deadline filter: an entry that expired while queued
+                # must not consume a kernel lane — and a batch whose
+                # EVERY member expired must not launch at all (the
+                # clients are gone; the device time would be pure
+                # waste). Classification per entry matches the wait
+                # paths: request deadline lapsed -> 504, local batch
+                # timeout only -> 503 (counters updated inside).
+                live = []
+                for p in batch:
+                    if p.deadline.expired():
+                        p.error = self._timeout_error(p.req_deadline)
+                        p.event.set()
+                    else:
+                        live.append(p)
+            except BaseException as e:
+                # a failure between pop and dispatch must not strand
+                # the popped batch: _run_batch never got it
+                for p in batch:
+                    if not p.event.is_set():
+                        p.error = e
+                        p.event.set()
+                raise
+            if me is not None and me.event.is_set() and live:
+                # our OWN entry was resolved by the filter just now
+                # (expired while we led): return its 503/504 at once
+                # instead of blocking this request's thread — and its
+                # admission slot — on other requests' launch. Push the
+                # live remainder back (front) so a drainer serves it;
+                # if leadership lapsed at the pop and someone else
+                # claimed it meanwhile, they will pop the push-back
+                # themselves — never spawn a second leader.
+                with acc.lock:
+                    acc.items = live + acc.items
+                    if more or not acc.leader_active:
+                        acc.leader_active = True
+                        spawn = True
+                    else:
+                        spawn = False
+                if spawn:
+                    threading.Thread(
+                        target=self._drain,
+                        args=(acc, dindex, window_cap, record_cap),
+                        name="batch-drain",
+                        daemon=True,
+                    ).start()
+                return
+            if live:
+                # launch on the launcher pool, wait bounded: a wedged
+                # launch fails this request with 503/504 instead of
+                # stranding it (and its admission slot) forever. The
+                # wait itself serializes launches per accumulator —
+                # that is the continuous-batching backpressure, keep
+                # it. The bound is the leading request's own deadline
+                # until its answer is in; a drainer uses a fresh
+                # default bound per launch.
+                bound = (
+                    me.deadline.remaining()
+                    if me is not None and not me.event.is_set()
+                    else self.default_timeout_s
+                )
+                try:
+                    done = self._launcher.submit(
+                        self._run_batch, live, dindex, window_cap,
+                        record_cap,
+                    )
+                except BaseException as e:
+                    # dispatch failure (launcher closed mid-shutdown):
+                    # the popped batch never reached _run_batch — fail
+                    # its members here or they wait out their full
+                    # bounds for a launch that will never happen
+                    for p in live:
+                        if not p.event.is_set():
+                            p.error = e
+                            p.event.set()
+                    raise
+                if not done.wait(bound):
+                    # the launch may still complete: its members keep
+                    # their own bounded event waits and get results or
+                    # their own expiry — only this serving loop gives
+                    # up. Leadership (held iff items remained at the
+                    # pop) passes to a fresh drainer so queued items
+                    # are served the moment the slow launch frees the
+                    # device, instead of stalling until the next
+                    # submit; when more was False it was already
+                    # released, and a NEW leader may hold it now —
+                    # don't clobber that.
+                    if more:
+                        self._handoff_or_release(
+                            acc, dindex, window_cap, record_cap
+                        )
+                    if me is None or me.event.is_set():
+                        # re-check live, not a pre-launch snapshot: the
+                        # launch may have delivered our answer right at
+                        # the bound — return it rather than miscast a
+                        # served request as an error
+                        return
+                    raise self._timeout_error(req_deadline)
+            if not more:
+                return
+
+    def _handoff_or_release(self, acc, dindex, window_cap, record_cap):
+        """Pass held leadership to a transient daemon drainer when
+        backlog remains, else release it — atomically, so no window
+        exists in which a new submit would elect a second leader."""
+        with acc.lock:
+            handoff = bool(acc.items)
+            if not handoff:
                 acc.leader_active = False
-                orphans, acc.items = acc.items, []
-            # fail both the still-queued items AND the already-dequeued
-            # batch: an exception escaping between the pop and _execute's
-            # per-item event.set() would otherwise strand batch followers
-            # on event.wait() forever
-            for p in orphans + batch:
+        if handoff:
+            threading.Thread(
+                target=self._drain,
+                args=(acc, dindex, window_cap, record_cap),
+                name="batch-drain",
+                daemon=True,
+            ).start()
+
+    def _drain(self, acc, dindex, window_cap, record_cap) -> None:
+        """Transient background drainer: continues the leadership loop
+        after the electing request returned (daemon thread; dies as
+        soon as the accumulator empties or a launch wedges)."""
+        try:
+            self._serve(acc, dindex, window_cap, record_cap, None, NO_DEADLINE)
+        except BaseException as e:  # pragma: no cover - failsafe
+            self._fail_queued(acc, e)
+
+    def _timeout_error(self, req_deadline) -> BaseException:
+        """Bounded-wait expiry, one classification for leader and
+        follower: the REQUEST deadline lapsed -> 504 semantics; only
+        the local batch timeout -> 503 server-side wedge."""
+        if req_deadline.expired():
+            with self._stats_lock:
+                self._n_expired += 1
+            return DeadlineExceeded(
+                "request deadline expired waiting for the kernel launch"
+            )
+        with self._stats_lock:
+            self._n_timeouts += 1
+        return BatchTimeout(
+            "kernel launch did not complete within the submit timeout "
+            "(wedged device or saturated launcher)"
+        )
+
+    def _run_batch(self, batch, dindex, window_cap, record_cap) -> None:
+        """Launcher-thread entry: _execute plus a failsafe so NO batch
+        member can be left without a result/error even if result
+        distribution itself raises — waiters' bounds are a backstop,
+        not the primary delivery mechanism."""
+        try:
+            self._execute(batch, dindex, window_cap, record_cap)
+        except BaseException as e:  # pragma: no cover - failsafe
+            for p in batch:
                 if not p.event.is_set():
                     p.error = e
                     p.event.set()
-            raise
+
+    def close(self) -> None:
+        """Release the launcher pool (long-lived batchers only die with
+        their engine; call through VariantEngine.close)."""
+        self._launcher.close()
 
     def timing_summary(self) -> dict:
         """Percentiles of the per-request decomposition: queue_wait_ms
@@ -210,6 +523,8 @@ class MicroBatcher:
                 "launches": launches,
                 "mean_batch": round(total / launches, 2) if launches else 0.0,
                 "histogram": hist,
+                "expired": self._n_expired,
+                "timeouts": self._n_timeouts,
             }
 
     def _execute(self, batch, dindex, window_cap, record_cap):
@@ -223,6 +538,9 @@ class MicroBatcher:
                 self._wait_ms.append((t_launch - p.t_submit) * 1e3)
         try:
             with span("serving.microbatch") as sp:
+                # chaos site: a raised fault takes the existing
+                # launch-failure path (every waiter gets the error)
+                fault_point("kernel.launch")
                 # shape bucketing happens INSIDE the kernels (the XLA
                 # path pads to kernel.BATCH_TIERS, the scatter path to
                 # its fixed chunk slots) — pre-padding here doubled the

@@ -255,7 +255,7 @@ def test_cooldown_skips_failing_worker(vcf):
             vstart=0, vend=1 << 40, sample_names=SAMPLES,
         )
         pool.scan(payload)  # first call burns the dead worker + marks it
-        assert pool._dead_until.get("http://127.0.0.1:9", 0) > 0
+        assert pool.breaker.state("http://127.0.0.1:9") == "open"
         picks = {pool._pick() for _ in range(4)}
         assert picks == {w.address}
     finally:
