@@ -313,17 +313,33 @@ class BeaconApp:
         return 200, self._metrics()
 
     def _metrics(self) -> dict:
-        """Resilience observability: admission, runner pool, batcher
-        occupancy, per-worker breaker states, armed fault plan."""
+        """Serving observability: admission, runner pool, batcher
+        occupancy (incl. launcher/fetcher pool depth and the
+        fused-batch histogram under their stable keys inside
+        ``batcher``), response-cache counters, per-worker breaker
+        states, armed fault plan."""
         out: dict = {
             "admission": self.admission.metrics(),
             "runner": self.query_runner.metrics(),
         }
+        local = getattr(self.engine, "local", None) or self.engine
         batcher = getattr(self.engine, "_batcher", None) or getattr(
-            getattr(self.engine, "local", None), "_batcher", None
+            local, "_batcher", None
         )
         if batcher is not None:
             out["batcher"] = batcher.occupancy()
+        cache_stats = getattr(local, "cache_stats", None)
+        if callable(cache_stats):
+            stats = cache_stats()
+            if stats is not None:
+                out["response_cache"] = stats
+        if hasattr(local, "fused_searches"):
+            # unconditional (stable keys): dashboards must see the
+            # series at 0, not have it flap into existence
+            out["engine"] = {
+                "fused_searches": local.fused_searches,
+                "mesh_searches": local.mesh_searches,
+            }
         breaker = getattr(self.engine, "breaker", None)
         if breaker is not None:
             out["breaker"] = breaker.metrics()

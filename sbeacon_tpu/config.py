@@ -119,6 +119,36 @@ class EngineConfig:
     microbatch: bool = True
     microbatch_max: int = 512
     microbatch_wait_ms: float = 0.0
+    # launched-but-unfetched kernel batches allowed per accumulator:
+    # the launch/fetch overlap window (serving.py pipeline). 1 = fully
+    # serial launch->fetch (pre-fusion behavior); 2 double-buffers so
+    # host encode of batch i+1 overlaps device execution of batch i
+    fetch_pipeline_depth: int = 2
+    # entries kept per timing ring (MicroBatcher wait/exec/stage
+    # decompositions) — bounds a long soak's memory, timing_summary()
+    # reports percentiles over this window
+    timing_window: int = 65536
+    # cross-shard fused dispatch: stack every warm device shard into
+    # ONE device index (ops.kernel.FusedDeviceIndex) so a k-dataset
+    # query costs one launch and concurrent queries against DIFFERENT
+    # datasets coalesce into the same micro-batch. Costs a second
+    # device-resident copy of the stacked columns (~48 B/row), so the
+    # stack is skipped beyond fused_max_rows total rows (~3 GB at the
+    # default).
+    fused_dispatch: bool = True
+    fused_max_rows: int = 64_000_000
+    # response cache (response_cache.py): LRU in front of
+    # engine.search keyed on (index fingerprint, normalized query,
+    # response shaping); negative results cache too. size<=0 or
+    # enabled=False disables; ttl_s=0 means no expiry.
+    response_cache: bool = True
+    response_cache_size: int = 4096
+    response_cache_ttl_s: float = 300.0
+    # chunk size for staged genotype-plane H2D uploads (plane_kernel):
+    # planes larger than one chunk upload as pre-staged contiguous
+    # chunks whose transfers overlap, instead of one giant synchronous
+    # copy (the 28 MB/s config7 upload wall). <=0 disables chunking.
+    plane_upload_chunk_mb: int = 256
     # device-resident genotype planes (selected-samples leaf): upload a
     # shard's bit planes to HBM when their padded size fits the budget;
     # oversized plane sets stay host-resident (round-3 numpy path). The
@@ -272,6 +302,33 @@ class BeaconConfig:
                 "false",
                 "no",
                 "off",
+            )
+        _off = ("0", "false", "no", "off")
+        if "BEACON_FUSED_DISPATCH" in env:
+            eng_over["fused_dispatch"] = (
+                env["BEACON_FUSED_DISPATCH"].lower() not in _off
+            )
+        if "BEACON_FUSED_MAX_ROWS" in env:
+            eng_over["fused_max_rows"] = int(env["BEACON_FUSED_MAX_ROWS"])
+        if "BEACON_RESPONSE_CACHE" in env:
+            eng_over["response_cache"] = (
+                env["BEACON_RESPONSE_CACHE"].lower() not in _off
+            )
+        if "BEACON_RESPONSE_CACHE_SIZE" in env:
+            eng_over["response_cache_size"] = int(
+                env["BEACON_RESPONSE_CACHE_SIZE"]
+            )
+        if "BEACON_RESPONSE_CACHE_TTL_S" in env:
+            eng_over["response_cache_ttl_s"] = float(
+                env["BEACON_RESPONSE_CACHE_TTL_S"]
+            )
+        if "BEACON_FETCH_PIPELINE_DEPTH" in env:
+            eng_over["fetch_pipeline_depth"] = int(
+                env["BEACON_FETCH_PIPELINE_DEPTH"]
+            )
+        if "BEACON_PLANE_UPLOAD_CHUNK_MB" in env:
+            eng_over["plane_upload_chunk_mb"] = int(
+                env["BEACON_PLANE_UPLOAD_CHUNK_MB"]
             )
         engine = EngineConfig(**eng_over)
         resolvers = ResolverConfig(

@@ -293,10 +293,23 @@ def run_concurrent_soak(
             "histogram": hist,
         }
         # tail attribution (VERDICT r3 #10): server-side queue wait vs
-        # device execute, so p99 is explainable as queueing behind
-        # in-flight launches vs dispatch/transport cost
+        # device execute — now split per stage (encode / launch /
+        # fetch, plus the engine's host materialize) so p99 is
+        # explainable down to the pipeline stage that owns it
         if hasattr(batcher, "timing_summary"):
             out["decomposition"] = batcher.timing_summary()
+        if hasattr(engine, "stage_timing"):
+            out.setdefault("decomposition", {}).update(
+                engine.stage_timing()
+            )
+    if engine is not None and callable(getattr(engine, "cache_stats", None)):
+        stats = engine.cache_stats()
+        if stats is not None:
+            out["response_cache"] = {
+                k: stats[k]
+                for k in ("hits", "misses", "hit_rate", "entries",
+                          "negative_hits", "evictions")
+            }
     if errors:
         out["first_errors"] = errors[:3]
     return out

@@ -662,6 +662,52 @@ def save_index_blob(blob: bytes, path: str | Path) -> dict:
     return meta
 
 
+def stack_shard_columns(
+    shards: list[VariantIndexShard],
+) -> tuple[dict[str, np.ndarray], np.ndarray, np.ndarray]:
+    """Stacked-shard device-column representation for fused dispatch.
+
+    Unlike :func:`merge_shards` (which interleaves rows into ONE globally
+    sorted order, destroying per-shard row identity), this keeps every
+    shard's rows contiguous and in their original order and adds a
+    per-shard segment table: the fused kernel answers a (shard, query)
+    pair by bisecting inside ``chrom_offsets[shard]`` exactly as the
+    single-shard kernel bisects inside its own offsets — one launch
+    covers specs against *any* warm shard.
+
+    Returns ``(cols, chrom_offsets, shard_base)``:
+
+    - ``cols``: every device column (incl. ``alt_prefix``) concatenated
+      in shard order,
+    - ``chrom_offsets``: int32[k, 27] — shard i's chromosome segment
+      table rebased to absolute stacked row ids,
+    - ``shard_base``: int64[k+1] — shard i's rows live at
+      ``[shard_base[i], shard_base[i+1])``; stacked row ids map back to
+      shard-local ids by subtracting ``shard_base[i]``.
+    """
+    if not shards:
+        raise ValueError("stack_shard_columns needs at least one shard")
+    base = np.zeros(len(shards) + 1, dtype=np.int64)
+    for i, s in enumerate(shards):
+        base[i + 1] = base[i] + s.n_rows
+    if base[-1] > int(INT32_MAX):
+        raise ValueError(
+            f"stacked index exceeds int32 row ids ({int(base[-1])} rows)"
+        )
+    names = list(DEVICE_COLUMNS) + ["alt_prefix"]
+    cols = {
+        name: np.concatenate([s.cols[name] for s in shards])
+        for name in names
+    }
+    chrom_offsets = np.stack(
+        [
+            s.chrom_offsets.astype(np.int64) + base[i]
+            for i, s in enumerate(shards)
+        ]
+    ).astype(np.int32)
+    return cols, chrom_offsets, base
+
+
 def merge_shards(shards: list[VariantIndexShard]) -> VariantIndexShard:
     """Merge per-VCF shards into one globally sorted shard (vectorised).
 

@@ -1,7 +1,9 @@
 from .kernel import (
     DeviceIndex,
+    FusedDeviceIndex,
     QueryResults,
     QuerySpec,
+    ReadyQueryResults,
     encode_queries,
     run_queries,
 )
@@ -38,23 +40,41 @@ def make_device_index(
 
 
 def run_queries_auto(
-    index, queries, *, window_cap: int = 2048, record_cap: int = 1024
-) -> QueryResults:
+    index,
+    queries,
+    *,
+    window_cap: int = 2048,
+    record_cap: int = 1024,
+    async_fetch: bool = False,
+):
     """Dispatch a query batch to whichever kernel the index was built
-    for — one call site for the engine and the micro-batcher."""
+    for — one call site for the engine and the micro-batcher.
+
+    ``async_fetch=True`` returns an object with ``.fetch() ->
+    QueryResults`` immediately after the launch is dispatched so the
+    caller can overlap host work with device execution (the scatter
+    tile kernels execute synchronously and return already-fetched
+    results behind the same contract)."""
     if isinstance(index, ScatterDeviceIndex):
-        return run_queries_scattered(
+        res = run_queries_scattered(
             index, queries, window_cap=window_cap, record_cap=record_cap
         )
+        return ReadyQueryResults(res) if async_fetch else res
     return run_queries(
-        index, queries, window_cap=window_cap, record_cap=record_cap
+        index,
+        queries,
+        window_cap=window_cap,
+        record_cap=record_cap,
+        async_fetch=async_fetch,
     )
 
 
 __all__ = [
     "DeviceIndex",
+    "FusedDeviceIndex",
     "QueryResults",
     "QuerySpec",
+    "ReadyQueryResults",
     "encode_queries",
     "make_device_index",
     "run_queries",

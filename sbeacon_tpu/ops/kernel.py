@@ -57,6 +57,12 @@ _VT_CODES = {
 # alt matching modes
 MODE_EXACT, MODE_ANY_BASE, MODE_TYPE = range(3)
 
+# device launches issued by this module (one per jitted query-batch
+# dispatch) — the perf_smoke evidence that fused dispatch and the
+# response cache actually collapse launches; scatter_kernel keeps its
+# own N_DISPATCHES for the TPU tile kernels
+N_LAUNCHES = 0
+
 
 @dataclass
 class QuerySpec:
@@ -74,8 +80,14 @@ class QuerySpec:
     variant_max_length: int = -1
 
 
-def encode_queries(queries: list[QuerySpec]) -> dict[str, np.ndarray]:
-    """Host-side encoding of a query batch into device arrays."""
+def encode_queries(
+    queries: list[QuerySpec], shard_ids: list[int] | None = None
+) -> dict[str, np.ndarray]:
+    """Host-side encoding of a query batch into device arrays.
+
+    ``shard_ids`` targets each query at one shard segment of a
+    :class:`FusedDeviceIndex` (the ``shard`` field selects the row of
+    its 2D ``chrom_offsets``); omitted for single-shard indexes."""
     b = len(queries)
     enc = {
         "chrom": np.zeros(b, np.int32),
@@ -95,6 +107,8 @@ def encode_queries(queries: list[QuerySpec]) -> dict[str, np.ndarray]:
         "min_len": np.zeros(b, np.int32),
         "max_len": np.zeros(b, np.int32),
     }
+    if shard_ids is not None:
+        enc["shard"] = np.asarray(shard_ids, dtype=np.int32)
     for i, q in enumerate(queries):
         enc["chrom"][i] = chromosome_code(q.chrom)
         enc["start_min"][i] = q.start_min
@@ -147,19 +161,28 @@ _PAD_FILLS = {
 }
 
 
+def pad_columns(
+    cols: dict[str, np.ndarray], n: int, n_pad: int
+) -> dict[str, np.ndarray]:
+    """``_PAD_FILLS``-padded copies of a device-column dict (single
+    shard or stacked) — THE one pad-and-fill implementation, so the
+    per-shard and fused indexes can never drift on pad-row sentinels."""
+    if n > n_pad:
+        raise ValueError(f"{n} rows > pad target {n_pad}")
+    out = {}
+    for name, fill in _PAD_FILLS.items():
+        col = cols[name]
+        padded = np.full((n_pad,) + col.shape[1:], fill, dtype=col.dtype)
+        padded[:n] = col
+        out[name] = padded
+    return out
+
+
 def pad_shard_columns(
     shard: VariantIndexShard, n_pad: int
 ) -> dict[str, np.ndarray]:
     """Host-side padded column dict (incl. chrom_offsets), numpy only."""
-    n = shard.n_rows
-    if n > n_pad:
-        raise ValueError(f"shard has {n} rows > pad target {n_pad}")
-    out = {}
-    for name, fill in _PAD_FILLS.items():
-        col = shard.cols[name]
-        padded = np.full((n_pad,) + col.shape[1:], fill, dtype=col.dtype)
-        padded[:n] = col
-        out[name] = padded
+    out = pad_columns(shard.cols, shard.n_rows, n_pad)
     out["chrom_offsets"] = shard.chrom_offsets.astype(np.int32)
     return out
 
@@ -194,6 +217,55 @@ class DeviceIndex:
             for k, v in pad_shard_columns(shard, n_pad).items()
         }
         self.n_iters = bisect_iters(n_pad)
+
+
+class FusedDeviceIndex:
+    """ALL warm shards stacked into one device index for fused dispatch.
+
+    Shard rows stay contiguous and in their original order
+    (``index.columnar.stack_shard_columns``); ``chrom_offsets`` becomes
+    a ``[k, 27]`` per-shard segment table and each encoded query carries
+    a ``shard`` id selecting its row. One ``_query_batch`` launch then
+    answers (shard, query) pairs against any mix of shards — a
+    k-dataset query costs ONE device launch instead of k, and the
+    serving micro-batcher coalesces queries for *different* datasets
+    into the same launch (previously each dataset's accumulator
+    launched separately).
+
+    Row ids come back as absolute stacked ids; ``shard_base[sid]``
+    maps them back to shard-local ids for host materialisation. The
+    index holds its own column copy (the per-shard device indexes —
+    XLA gather or scatter-tile — stay alive for fallback and
+    single-target paths), so the engine only builds it when >= 2
+    shards are warm and the stacked row count fits
+    ``fused_max_rows`` — budget notes in DEPLOYMENT.md.
+    """
+
+    PAD_UNIT = 8192
+
+    def __init__(
+        self, shards: list[VariantIndexShard], pad_unit: int | None = None
+    ):
+        from ..index.columnar import stack_shard_columns
+
+        cols, chrom_offsets, base = stack_shard_columns(shards)
+        n = int(base[-1])
+        n_pad = padded_rows(n, pad_unit or self.PAD_UNIT)
+        arrays = {
+            k: jnp.asarray(v)
+            for k, v in pad_columns(cols, n, n_pad).items()
+        }
+        arrays["chrom_offsets"] = jnp.asarray(chrom_offsets)
+        self.arrays = arrays
+        self.n_rows = n
+        self.n_padded = n_pad
+        self.n_iters = bisect_iters(n_pad)
+        self.n_shards = len(shards)
+        self.shard_base = base  # int64[k+1]
+
+    def to_local_rows(self, rows: np.ndarray, sid: int) -> np.ndarray:
+        """Stacked row ids (already -1-filtered) -> shard-local ids."""
+        return rows.astype(np.int64) - int(self.shard_base[sid])
 
 
 @dataclass
@@ -238,8 +310,15 @@ def _query_one(arrays, q, *, window_cap: int, record_cap: int, n_iters: int):
     offsets = arrays["chrom_offsets"]
     n = pos.shape[0]
 
-    seg_lo = offsets[q["chrom"]]
-    seg_hi = offsets[q["chrom"] + 1]
+    if offsets.ndim == 2:
+        # fused multi-shard index: the query's shard id selects its
+        # segment table row; the bisection then never leaves that
+        # shard's contiguous row span
+        seg_lo = offsets[q["shard"], q["chrom"]]
+        seg_hi = offsets[q["shard"], q["chrom"] + 1]
+    else:
+        seg_lo = offsets[q["chrom"]]
+        seg_hi = offsets[q["chrom"] + 1]
     lo = _bisect(pos, q["start_min"], seg_lo, seg_hi, n_iters, upper=False)
     hi = _bisect(pos, q["start_max"], seg_lo, seg_hi, n_iters, upper=True)
 
@@ -353,14 +432,61 @@ def _query_batch(arrays, enc, *, window_cap, record_cap, n_iters):
 BATCH_TIERS = (8, 64, 512, 2048)
 
 
+class PendingQueryResults:
+    """An in-flight query batch: the launch has been dispatched, the
+    device-to-host fetch is deferred to :meth:`fetch`.
+
+    JAX dispatch is asynchronous — ``_query_batch`` returns device
+    futures — so splitting launch from fetch lets the serving layer
+    overlap host work (encoding batch i+1, materialising batch i-1)
+    with the device execution of batch i instead of blocking the
+    launcher thread inside ``device_get``."""
+
+    __slots__ = ("_out", "_b")
+
+    def __init__(self, out, b: int):
+        self._out = out
+        self._b = b
+
+    def fetch(self) -> QueryResults:
+        out = jax.device_get(self._out)
+        self._out = None  # free the device buffers promptly
+        b = self._b
+        return QueryResults(
+            exists=np.asarray(out["exists"])[:b],
+            call_count=np.asarray(out["call_count"])[:b],
+            n_variants=np.asarray(out["n_variants"])[:b],
+            all_alleles_count=np.asarray(out["all_alleles_count"])[:b],
+            n_matched=np.asarray(out["n_matched"])[:b],
+            overflow=np.asarray(out["overflow"])[:b],
+            rows=np.asarray(out["rows"])[:b],
+        )
+
+
+class ReadyQueryResults:
+    """Already-fetched results behind the PendingQueryResults contract
+    (kernels that execute synchronously, e.g. the scatter tile path)."""
+
+    __slots__ = ("_res",)
+
+    def __init__(self, res: QueryResults):
+        self._res = res
+
+    def fetch(self) -> QueryResults:
+        return self._res
+
+
 def run_queries(
     dindex: DeviceIndex,
     queries: list[QuerySpec] | dict[str, np.ndarray],
     *,
     window_cap: int = 2048,
     record_cap: int = 1024,
-) -> QueryResults:
-    """Execute a query batch against one device index shard.
+    async_fetch: bool = False,
+):
+    """Execute a query batch against one device index (single-shard
+    ``DeviceIndex`` or stacked ``FusedDeviceIndex``; fused batches must
+    arrive pre-encoded with their ``shard`` ids).
 
     The batch pads up to a fixed size tier (``BATCH_TIERS``, repeating
     query 0 — always semantically inert, outputs trimmed) so the
@@ -368,7 +494,12 @@ def run_queries(
     every micro-batch size the serving batcher can emit: un-padded, a
     16-client soak compiled a fresh program per novel batch size
     mid-request — the r4 soak tail (VERDICT r4 next #7).
+
+    ``async_fetch=True`` returns a :class:`PendingQueryResults` right
+    after dispatch (launch/fetch overlap); default blocks and returns
+    :class:`QueryResults`.
     """
+    global N_LAUNCHES
     enc = (
         encode_queries(queries) if isinstance(queries, list) else queries
     )
@@ -390,14 +521,9 @@ def run_queries(
             record_cap=record_cap,
             n_iters=dindex.n_iters,
         )
-        out = jax.device_get(out)
+        N_LAUNCHES += 1
         sp.note(batch=b)
-    return QueryResults(
-        exists=np.asarray(out["exists"])[:b],
-        call_count=np.asarray(out["call_count"])[:b],
-        n_variants=np.asarray(out["n_variants"])[:b],
-        all_alleles_count=np.asarray(out["all_alleles_count"])[:b],
-        n_matched=np.asarray(out["n_matched"])[:b],
-        overflow=np.asarray(out["overflow"])[:b],
-        rows=np.asarray(out["rows"])[:b],
-    )
+    pending = PendingQueryResults(out, b)
+    if async_fetch:
+        return pending
+    return pending.fetch()

@@ -1,0 +1,159 @@
+"""Fingerprint-keyed response cache for the query hot path.
+
+The reference stubs exactly this out — ``dynamodb/variant_queries.py:
+94-103`` ("TODO implement caching") keeps a VariantQueries table row per
+query but never serves repeats from it. Here the cache sits directly in
+front of :meth:`VariantEngine.search`: a repeated query (same normalized
+spec, same response-shaping fields, same loaded index set) is served
+from host memory with ZERO device launches, which is the difference
+between ~exec_ms and ~microseconds on the soak's hot keys.
+
+Correctness model:
+
+- The key embeds ``engine.index_fingerprint()``, so any (re-)ingestion
+  — ``add_index`` / ``_publish_index`` bumps the fingerprint — makes
+  every cached entry unreachable; the engine additionally clears the
+  cache on publish so stale entries don't squat in the LRU.
+- Entries are stored AND returned as copies (dataclass replace with
+  fresh lists): neither a caller mutating its response nor a later hit
+  can corrupt the cached value.
+- Negative entries are first-class: a query matching nothing caches its
+  (empty / exists=False) response set like any other and repeats skip
+  dispatch entirely — the Beacon workload is dominated by misses
+  ("is this rare variant here?" is usually answered "no").
+
+Bounded by ``max_entries`` (LRU eviction) and ``ttl_s`` (per-entry
+expiry; 0 disables). Hit/miss/eviction/expiry counters surface at
+``/metrics`` next to the batcher stats.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import OrderedDict
+
+from .payloads import VariantQueryPayload, VariantSearchResponse
+
+
+def copy_response(r: VariantSearchResponse) -> VariantSearchResponse:
+    """A safe-to-mutate copy (fresh list objects, shared strings)."""
+    return dataclasses.replace(
+        r,
+        variants=list(r.variants),
+        sample_indices=list(r.sample_indices),
+        sample_names=list(r.sample_names),
+    )
+
+
+def response_cache_key(
+    fingerprint: str, payload: VariantQueryPayload
+) -> tuple:
+    """Hashable cache key: index identity + the normalized QuerySpec
+    fields + every response-shaping field.
+
+    Normalization mirrors the matcher's semantics — allele compares are
+    case-insensitive (``engine._blob_eq`` uppercases both sides), so
+    ``refA``/``REFA`` must share an entry; dataset order is irrelevant
+    to the response SET, so ids sort. ``query_id`` is correctly absent:
+    it names the request, not the answer.
+    """
+    ref = payload.reference_bases
+    alt = payload.alternate_bases
+    return (
+        fingerprint,
+        # -- normalized QuerySpec ------------------------------------
+        payload.reference_name,
+        payload.start_min,
+        payload.start_max,
+        payload.end_min,
+        payload.end_max,
+        None if ref is None else ref.upper(),
+        None if alt is None else alt.upper(),
+        payload.variant_type,
+        payload.variant_min_length,
+        payload.variant_max_length,
+        # -- response shaping ----------------------------------------
+        tuple(sorted(payload.dataset_ids)),
+        payload.requested_granularity,
+        payload.include_datasets,
+        payload.include_samples,
+        payload.selected_samples_only,
+        tuple(
+            (ds, tuple(sorted(names)))
+            for ds, names in sorted(payload.sample_names.items())
+        ),
+    )
+
+
+class ResponseCache:
+    """Thread-safe LRU with TTL and observability counters."""
+
+    def __init__(self, max_entries: int = 4096, ttl_s: float = 300.0):
+        self.max_entries = max(1, int(max_entries))
+        self.ttl_s = float(ttl_s)
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[tuple, tuple[float, list]]" = (
+            OrderedDict()
+        )
+        self._hits = 0
+        self._misses = 0
+        self._evictions = 0
+        self._expirations = 0
+        self._invalidations = 0
+        self._negative_hits = 0
+
+    def get(self, key: tuple) -> list[VariantSearchResponse] | None:
+        """Cached response set (fresh copies) or None."""
+        now = time.monotonic()
+        with self._lock:
+            item = self._entries.get(key)
+            if item is None:
+                self._misses += 1
+                return None
+            t_put, responses = item
+            if self.ttl_s > 0 and (now - t_put) > self.ttl_s:
+                del self._entries[key]
+                self._expirations += 1
+                self._misses += 1
+                return None
+            self._entries.move_to_end(key)
+            self._hits += 1
+            if not any(r.exists for r in responses):
+                self._negative_hits += 1
+            return [copy_response(r) for r in responses]
+
+    def put(self, key: tuple, responses: list[VariantSearchResponse]) -> None:
+        value = (time.monotonic(), [copy_response(r) for r in responses])
+        with self._lock:
+            self._entries[key] = value
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.max_entries:
+                self._entries.popitem(last=False)
+                self._evictions += 1
+
+    def invalidate(self) -> None:
+        """Drop everything (index set changed: the fingerprint in the
+        key already makes old entries unreachable, this frees them)."""
+        with self._lock:
+            self._entries.clear()
+            self._invalidations += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            lookups = self._hits + self._misses
+            return {
+                "entries": len(self._entries),
+                "max_entries": self.max_entries,
+                "ttl_s": self.ttl_s,
+                "hits": self._hits,
+                "misses": self._misses,
+                "hit_rate": (
+                    round(self._hits / lookups, 4) if lookups else 0.0
+                ),
+                "negative_hits": self._negative_hits,
+                "evictions": self._evictions,
+                "expirations": self._expirations,
+                "invalidations": self._invalidations,
+            }

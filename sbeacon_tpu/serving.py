@@ -44,8 +44,15 @@ from .utils.trace import span
 
 @dataclass
 class _Pending:
-    spec: object
+    #: the submission's query specs — one for a plain submit, several
+    #: for a fused multi-shard submission (submit_many); the result is
+    #: the matching row-slice of the batched QueryResults
+    specs: list
     event: threading.Event
+    #: per-spec shard ids into a FusedDeviceIndex; None on single-shard
+    #: indexes (all submissions in one accumulator share the index, so
+    #: they either all carry ids or none do)
+    shard_ids: list | None = None
     result: object = None
     error: BaseException | None = None
     t_submit: float = 0.0
@@ -59,10 +66,16 @@ class _Pending:
 class _Accumulator:
     """Per-(device-index, caps) accumulation queue."""
 
-    def __init__(self):
+    def __init__(self, pipeline_depth: int = 1):
         self.lock = threading.Lock()
         self.items: list[_Pending] = []
         self.leader_active = False
+        # bounds launched-but-unfetched batches: launch stage acquires,
+        # fetch stage releases. Depth 1 reproduces the old fully-serial
+        # launch->fetch behaviour; depth 2 overlaps the host-side
+        # encode of batch i+1 with the device execution of batch i
+        # while still making arrivals queue (continuous batching)
+        self.pipeline = threading.BoundedSemaphore(max(1, pipeline_depth))
 
 
 class _LaunchPool:
@@ -121,6 +134,12 @@ class _LaunchPool:
         for _ in range(n):
             self._q.put(None)
 
+    def depth(self) -> dict:
+        """{'threads': spawned workers, 'queued': tasks not yet picked
+        up} — the /metrics launcher-pool depth."""
+        with self._lock:
+            return {"threads": self._n_threads, "queued": self._q.qsize()}
+
 
 class MicroBatcher:
     """Batches kernel launches per device index.
@@ -136,6 +155,8 @@ class MicroBatcher:
         max_batch: int = 512,
         max_wait_ms: float = 2.0,
         default_timeout_s: float | None = None,
+        pipeline_depth: int = 2,
+        timing_window: int = 65536,
     ):
         self.max_batch = max_batch
         self.max_wait_s = max_wait_ms / 1e3
@@ -144,17 +165,30 @@ class MicroBatcher:
         # behind a wedged launch (the pre-resilience follower hang).
         # None = unbounded (explicit opt-out, e.g. micro tests).
         self.default_timeout_s = default_timeout_s
+        # launched-but-unfetched batches allowed per accumulator (the
+        # launch/fetch overlap window); 1 = fully serial (old behavior)
+        self.pipeline_depth = pipeline_depth
         # occupancy accounting (the soak harness's evidence that
         # batching engages under concurrency): {batch_size: n_launches}
         self._stats_lock = threading.Lock()
         self._batch_hist: dict[int, int] = {}
+        # flattened query-spec count per launch: differs from
+        # _batch_hist when fused multi-shard submissions ride along
+        # (one submission = k specs) — the /metrics fused-batch hist
+        self._fused_hist: dict[int, int] = {}
         self._n_submits = 0
+        self._n_specs = 0
         # per-request latency decomposition (soak-tail attribution,
-        # VERDICT r3 #10): queue wait (submit -> kernel launch) vs
-        # device execute (launch -> results ready). Bounded ring so a
-        # long-lived server cannot grow it unboundedly.
-        self._wait_ms: deque = deque(maxlen=65536)
-        self._exec_ms: deque = deque(maxlen=65536)
+        # VERDICT r3 #10): queue wait (submit -> kernel launch), device
+        # execute (launch -> results ready), and the per-stage split
+        # (encode / launch dispatch / fetch). Bounded rings sized by
+        # ``timing_window`` so a long-lived server cannot grow them
+        # unboundedly; timing_summary() reports over this window.
+        self._wait_ms: deque = deque(maxlen=timing_window)
+        self._exec_ms: deque = deque(maxlen=timing_window)
+        self._encode_ms: deque = deque(maxlen=timing_window)
+        self._launch_ms: deque = deque(maxlen=timing_window)
+        self._fetch_ms: deque = deque(maxlen=timing_window)
         # resilience observability: submits that expired before their
         # launch (leader-side filter) / timed out waiting (follower)
         self._n_expired = 0
@@ -173,10 +207,14 @@ class MicroBatcher:
         # thread — which recovers if the launch ever returns and never
         # blocks process exit — not the request thread and its
         # admission slot. The leader still BLOCKS on the in-flight
-        # launch before popping the next batch — that serialization is
-        # what makes arrivals accumulate into batches (continuous
-        # batching), so it must not be dispatched away.
+        # launch stage before returning — combined with the
+        # accumulator's bounded fetch pipeline that is what makes
+        # arrivals accumulate into batches (continuous batching).
         self._launcher = _LaunchPool(16, "kernel-launch")
+        # device-to-host fetches run here, decoupled from launches:
+        # while batch i's results stream back, the launcher is already
+        # encoding + dispatching batch i+1
+        self._fetcher = _LaunchPool(16, "kernel-fetch")
 
     def _accum(self, dindex, caps: tuple) -> _Accumulator:
         with self._lock:
@@ -186,7 +224,7 @@ class MicroBatcher:
                 self._accums[dindex] = by_caps
             acc = by_caps.get(caps)
             if acc is None:
-                acc = by_caps[caps] = _Accumulator()
+                acc = by_caps[caps] = _Accumulator(self.pipeline_depth)
             return acc
 
     def submit(
@@ -197,10 +235,12 @@ class MicroBatcher:
         window_cap: int,
         record_cap: int,
         timeout_s: float | None = None,
+        shard_id: int | None = None,
     ):
         """Returns (exists, call_count, n_variants, all_alleles_count,
         n_matched, overflow, rows) for this one query — one row of the
-        batched QueryResults.
+        batched QueryResults. ``shard_id`` targets the query at one
+        shard segment of a FusedDeviceIndex.
 
         The wait is bounded by the tightest of ``timeout_s``, the
         batcher's ``default_timeout_s``, and the caller thread's ambient
@@ -208,13 +248,39 @@ class MicroBatcher:
         queued — no launch happened in time) or
         :class:`DeadlineExceeded` (the leader filtered this entry as
         already-expired before launching)."""
+        return self.submit_many(
+            dindex,
+            [spec],
+            window_cap=window_cap,
+            record_cap=record_cap,
+            timeout_s=timeout_s,
+            shard_ids=None if shard_id is None else [shard_id],
+        )
+
+    def submit_many(
+        self,
+        dindex,
+        specs: list,
+        *,
+        window_cap: int,
+        record_cap: int,
+        timeout_s: float | None = None,
+        shard_ids: list | None = None,
+    ):
+        """One fused submission of several specs (a k-dataset query
+        against a FusedDeviceIndex): ALL of them ride in the same
+        batch and therefore the same kernel launch, and the returned
+        QueryResults carries one row per spec in order. Waiting/expiry
+        semantics are exactly :meth:`submit`'s — the submission is one
+        queue entry."""
         acc = self._accum(dindex, (window_cap, record_cap))
         req_deadline = current_deadline()
         deadline = req_deadline.combine(
             timeout_s if timeout_s is not None else self.default_timeout_s
         )
         me = _Pending(
-            spec=spec,
+            specs=list(specs),
+            shard_ids=None if shard_ids is None else list(shard_ids),
             event=threading.Event(),
             t_submit=time.perf_counter(),
             deadline=deadline,
@@ -222,6 +288,7 @@ class MicroBatcher:
         )
         with self._stats_lock:
             self._n_submits += 1
+            self._n_specs += len(me.specs)
 
         with acc.lock:
             acc.items.append(me)
@@ -233,6 +300,12 @@ class MicroBatcher:
 
         if lead:
             self._lead(acc, dindex, window_cap, record_cap, me, req_deadline)
+            # the launch stage is done (or our entry was filtered) but
+            # with the async fetch split the RESULT may still be in
+            # flight — wait for it, bounded exactly like a follower
+            me.event.wait(deadline.remaining())
+            if not me.event.is_set():
+                raise self._timeout_error(req_deadline)
         else:
             me.event.wait(deadline.remaining())
             if not me.event.is_set():
@@ -319,8 +392,22 @@ class MicroBatcher:
             batch: list[_Pending] = []
             try:
                 with acc.lock:
-                    batch = acc.items[: self.max_batch]
-                    acc.items = acc.items[self.max_batch :]
+                    # cap by FLATTENED spec count, not submissions: a
+                    # fused submit_many entry carries k specs, and a
+                    # batch whose flattened size tops kernel.BATCH_TIERS
+                    # would compile a fresh exact-size program
+                    # mid-request (the r4 soak tail). A single
+                    # oversized submission still goes alone.
+                    n_specs = n_take = 0
+                    for p in acc.items:
+                        if n_take and n_specs + len(p.specs) > self.max_batch:
+                            break
+                        n_take += 1
+                        n_specs += len(p.specs)
+                        if n_take >= self.max_batch:
+                            break
+                    batch = acc.items[:n_take]
+                    acc.items = acc.items[n_take:]
                     more = bool(acc.items)
                     if not more:
                         acc.leader_active = False
@@ -376,11 +463,12 @@ class MicroBatcher:
                 # launch on the launcher pool, wait bounded: a wedged
                 # launch fails this request with 503/504 instead of
                 # stranding it (and its admission slot) forever. The
-                # wait itself serializes launches per accumulator —
-                # that is the continuous-batching backpressure, keep
-                # it. The bound is the leading request's own deadline
-                # until its answer is in; a drainer uses a fresh
-                # default bound per launch.
+                # launch stage ends at kernel DISPATCH (the fetch runs
+                # on the fetcher pool) — per-accumulator backpressure
+                # comes from the bounded fetch pipeline the launch
+                # stage acquires into. The bound is the leading
+                # request's own deadline until its answer is in; a
+                # drainer uses a fresh default bound per launch.
                 bound = (
                     me.deadline.remaining()
                     if me is not None and not me.event.is_set()
@@ -388,7 +476,7 @@ class MicroBatcher:
                 )
                 try:
                     done = self._launcher.submit(
-                        self._run_batch, live, dindex, window_cap,
+                        self._run_batch, acc, live, dindex, window_cap,
                         record_cap,
                     )
                 except BaseException as e:
@@ -423,6 +511,18 @@ class MicroBatcher:
                         # served request as an error
                         return
                     raise self._timeout_error(req_deadline)
+                if me is not None:
+                    # our own entry was in that batch (the leading
+                    # request is always in the FIRST pop): its result
+                    # (or error) arrives via the fetch stage and
+                    # submit_many's bounded event wait — hand any
+                    # backlog to a drainer and stop serving other
+                    # requests' batches on this request's clock
+                    if more:
+                        self._handoff_or_release(
+                            acc, dindex, window_cap, record_cap
+                        )
+                    return
             if not more:
                 return
 
@@ -468,13 +568,13 @@ class MicroBatcher:
             "(wedged device or saturated launcher)"
         )
 
-    def _run_batch(self, batch, dindex, window_cap, record_cap) -> None:
+    def _run_batch(self, acc, batch, dindex, window_cap, record_cap) -> None:
         """Launcher-thread entry: _execute plus a failsafe so NO batch
         member can be left without a result/error even if result
         distribution itself raises — waiters' bounds are a backstop,
         not the primary delivery mechanism."""
         try:
-            self._execute(batch, dindex, window_cap, record_cap)
+            self._execute(acc, batch, dindex, window_cap, record_cap)
         except BaseException as e:  # pragma: no cover - failsafe
             for p in batch:
                 if not p.event.is_set():
@@ -482,17 +582,22 @@ class MicroBatcher:
                     p.event.set()
 
     def close(self) -> None:
-        """Release the launcher pool (long-lived batchers only die with
-        their engine; call through VariantEngine.close)."""
+        """Release the launcher + fetcher pools (long-lived batchers
+        only die with their engine; call through VariantEngine.close)."""
         self._launcher.close()
+        self._fetcher.close()
 
     def timing_summary(self) -> dict:
-        """Percentiles of the per-request decomposition: queue_wait_ms
-        (submit -> kernel launch; server-side queueing behind in-flight
-        launches) and exec_ms (launch -> results; the device dispatch
-        incl. any tunnel RTT). client_latency ~= queue_wait + exec +
-        HTTP/materialisation overhead — the soak harness reports all
-        three so tails are attributable."""
+        """Percentiles of the per-request decomposition over the
+        bounded ``timing_window``: queue_wait_ms (submit -> kernel
+        launch; server-side queueing behind in-flight launches) and
+        exec_ms (launch -> results; the device dispatch incl. any
+        tunnel RTT), plus the per-launch stage split — encode_ms
+        (host query encoding), launch_ms (async kernel dispatch) and
+        fetch_ms (device execution + device-to-host readback).
+        client_latency ~= queue_wait + exec + HTTP/materialisation
+        overhead — the soak harness reports all of these so tails are
+        attributable to a stage."""
         import numpy as np
 
         def pct(xs):
@@ -509,30 +614,65 @@ class MicroBatcher:
             return {
                 "queue_wait_ms": pct(list(self._wait_ms)),
                 "exec_ms": pct(list(self._exec_ms)),
+                "encode_ms": pct(list(self._encode_ms)),
+                "launch_ms": pct(list(self._launch_ms)),
+                "fetch_ms": pct(list(self._fetch_ms)),
             }
 
     def occupancy(self) -> dict:
         """{'submits': N, 'launches': M, 'mean_batch': x, 'histogram':
-        {size: count}} — cumulative since construction."""
+        {submissions_per_launch: count}, 'fused_hist':
+        {specs_per_launch: count}, 'launcher': {...}, 'fetcher': {...}}
+        — cumulative since construction. ``fused_hist`` differs from
+        ``histogram`` exactly when fused multi-shard submissions rode
+        along (one submission carrying k specs); ``launcher``/
+        ``fetcher`` report pool depth (threads spawned, tasks queued)
+        under stable keys for /metrics."""
         with self._stats_lock:
             hist = dict(sorted(self._batch_hist.items()))
+            fused_hist = dict(sorted(self._fused_hist.items()))
             launches = sum(hist.values())
             total = sum(k * v for k, v in hist.items())
-            return {
+            out = {
                 "submits": self._n_submits,
+                "specs": self._n_specs,
                 "launches": launches,
                 "mean_batch": round(total / launches, 2) if launches else 0.0,
                 "histogram": hist,
+                "fused_hist": fused_hist,
                 "expired": self._n_expired,
                 "timeouts": self._n_timeouts,
             }
+        out["launcher"] = self._launcher.depth()
+        out["fetcher"] = self._fetcher.depth()
+        return out
 
-    def _execute(self, batch, dindex, window_cap, record_cap):
-        specs = [p.spec for p in batch]
+    def _execute(self, acc, batch, dindex, window_cap, record_cap):
+        """LAUNCH stage (launcher thread): flatten the batch's specs,
+        encode and dispatch ONE kernel launch, then hand the in-flight
+        device futures to the fetcher pool. Returning here (which sets
+        the leader's ``done`` event) means only that the launch is
+        dispatched — results are delivered by :meth:`_fetch_batch`, so
+        host encode of the next batch overlaps device execution of
+        this one. The accumulator's bounded fetch pipeline is acquired
+        BEFORE dispatch and released by the fetch stage: at most
+        ``pipeline_depth`` batches are ever launched-but-unfetched."""
+        specs: list = []
+        offsets: list[int] = []
+        for p in batch:
+            offsets.append(len(specs))
+            specs.extend(p.specs)
+        shard_ids = None
+        if batch and batch[0].shard_ids is not None:
+            shard_ids = [s for p in batch for s in p.shard_ids]
+        acc.pipeline.acquire()
         t_launch = time.perf_counter()
         with self._stats_lock:
-            self._batch_hist[len(specs)] = (
-                self._batch_hist.get(len(specs), 0) + 1
+            self._batch_hist[len(batch)] = (
+                self._batch_hist.get(len(batch), 0) + 1
+            )
+            self._fused_hist[len(specs)] = (
+                self._fused_hist.get(len(specs), 0) + 1
             )
             for p in batch:
                 self._wait_ms.append((t_launch - p.t_submit) * 1e3)
@@ -545,32 +685,75 @@ class MicroBatcher:
                 # path pads to kernel.BATCH_TIERS, the scatter path to
                 # its fixed chunk slots) — pre-padding here doubled the
                 # copy and turned pad rows into extra scatter dispatches
-                enc = encode_queries(specs)
-                res = run_queries_auto(
+                enc = encode_queries(specs, shard_ids=shard_ids)
+                t_enc = time.perf_counter()
+                pending = run_queries_auto(
                     dindex,
                     enc,
                     window_cap=window_cap,
                     record_cap=record_cap,
+                    async_fetch=True,
                 )
+                t_disp = time.perf_counter()
                 sp.note(batch=len(specs))
         except BaseException as e:
+            acc.pipeline.release()
             for p in batch:
                 p.error = e
                 p.event.set()
             return
-        t_done = time.perf_counter()
         with self._stats_lock:
-            exec_ms = (t_done - t_launch) * 1e3
-            for _ in batch:
-                self._exec_ms.append(exec_ms)
-        for i, p in enumerate(batch):
-            p.result = QueryResults(
-                exists=res.exists[i : i + 1],
-                call_count=res.call_count[i : i + 1],
-                n_variants=res.n_variants[i : i + 1],
-                all_alleles_count=res.all_alleles_count[i : i + 1],
-                n_matched=res.n_matched[i : i + 1],
-                overflow=res.overflow[i : i + 1],
-                rows=res.rows[i : i + 1],
+            self._encode_ms.append((t_enc - t_launch) * 1e3)
+            self._launch_ms.append((t_disp - t_enc) * 1e3)
+        try:
+            self._fetcher.submit(
+                self._fetch_batch,
+                acc,
+                batch,
+                offsets,
+                pending,
+                t_launch,
+                t_disp,
             )
-            p.event.set()
+        except BaseException as e:
+            # fetcher closed mid-shutdown: the dispatched launch has no
+            # fetcher — fail the batch here or its members wait out
+            # their full bounds for results that will never arrive
+            acc.pipeline.release()
+            for p in batch:
+                if not p.event.is_set():
+                    p.error = e
+                    p.event.set()
+
+    def _fetch_batch(
+        self, acc, batch, offsets, pending, t_launch, t_disp
+    ) -> None:
+        """FETCH stage (fetcher thread): block on the device results,
+        hand each submission its row-slice, release the pipeline slot."""
+        try:
+            res = pending.fetch()
+            t_done = time.perf_counter()
+            with self._stats_lock:
+                exec_ms = (t_done - t_launch) * 1e3
+                self._fetch_ms.append((t_done - t_disp) * 1e3)
+                for _ in batch:
+                    self._exec_ms.append(exec_ms)
+            for p, off in zip(batch, offsets):
+                sl = slice(off, off + len(p.specs))
+                p.result = QueryResults(
+                    exists=res.exists[sl],
+                    call_count=res.call_count[sl],
+                    n_variants=res.n_variants[sl],
+                    all_alleles_count=res.all_alleles_count[sl],
+                    n_matched=res.n_matched[sl],
+                    overflow=res.overflow[sl],
+                    rows=res.rows[sl],
+                )
+                p.event.set()
+        except BaseException as e:
+            for p in batch:
+                if not p.event.is_set():
+                    p.error = e
+                    p.event.set()
+        finally:
+            acc.pipeline.release()

@@ -1,0 +1,212 @@
+"""Cross-shard fused dispatch: stacked-index parity with the per-shard
+kernel, submit_many semantics, overflow fallback, and cross-accumulator
+coalescing through the micro-batcher."""
+
+import random
+import threading
+
+import numpy as np
+import pytest
+
+from sbeacon_tpu.engine import host_match_rows
+from sbeacon_tpu.index.columnar import build_index, stack_shard_columns
+from sbeacon_tpu.ops.kernel import (
+    DeviceIndex,
+    FusedDeviceIndex,
+    QuerySpec,
+    encode_queries,
+    run_queries,
+)
+from sbeacon_tpu.serving import MicroBatcher
+from sbeacon_tpu.testing import random_records
+
+
+@pytest.fixture(scope="module")
+def corpus():
+    shards = []
+    for d in range(4):
+        rng = random.Random(70 + d)
+        recs = random_records(rng, chrom="1", n=300, n_samples=2)
+        shards.append(
+            build_index(
+                recs,
+                dataset_id=f"d{d}",
+                vcf_location=f"v{d}",
+                sample_names=["S0", "S1"],
+            )
+        )
+    dindexes = [DeviceIndex(s, pad_unit=1024) for s in shards]
+    findex = FusedDeviceIndex(shards, pad_unit=1024)
+    return shards, dindexes, findex
+
+
+def _specs(shard, n, seed):
+    rng = random.Random(seed)
+    pos = shard.cols["pos"]
+    out = []
+    for _ in range(n):
+        p = int(pos[rng.randrange(len(pos))])
+        out.append(
+            QuerySpec(
+                "1", max(1, p - 50), p + 50, 1, 1 << 30,
+                alternate_bases="N",
+            )
+        )
+    return out
+
+
+def test_stack_shard_columns_layout(corpus):
+    shards, _d, findex = corpus
+    cols, offs, base = stack_shard_columns(shards)
+    assert offs.shape == (4, 27)
+    assert base[-1] == sum(s.n_rows for s in shards)
+    for i, s in enumerate(shards):
+        np.testing.assert_array_equal(
+            cols["pos"][base[i] : base[i + 1]], s.cols["pos"]
+        )
+        np.testing.assert_array_equal(
+            offs[i], s.chrom_offsets.astype(np.int64) + base[i]
+        )
+    assert findex.n_shards == 4
+    assert findex.n_rows == int(base[-1])
+
+
+def test_fused_matches_per_shard_kernel(corpus):
+    """Every (shard, spec) pair answered by ONE fused launch must agree
+    with the per-shard kernel row-for-row (after base subtraction)."""
+    shards, dindexes, findex = corpus
+    specs, sids = [], []
+    for sid, shard in enumerate(shards):
+        for s in _specs(shard, 5, seed=sid):
+            specs.append(s)
+            sids.append(sid)
+    fused = run_queries(
+        findex,
+        encode_queries(specs, shard_ids=sids),
+        window_cap=256,
+        record_cap=64,
+    )
+    for i, (spec, sid) in enumerate(zip(specs, sids)):
+        one = run_queries(
+            dindexes[sid], [spec], window_cap=256, record_cap=64
+        )
+        assert bool(fused.exists[i]) == bool(one.exists[0])
+        assert int(fused.call_count[i]) == int(one.call_count[0])
+        assert int(fused.all_alleles_count[i]) == int(
+            one.all_alleles_count[0]
+        )
+        assert bool(fused.overflow[i]) == bool(one.overflow[0])
+        frows = fused.rows[i][fused.rows[i] >= 0]
+        frows = findex.to_local_rows(frows, sid)
+        orows = one.rows[0][one.rows[0] >= 0]
+        np.testing.assert_array_equal(frows, orows)
+
+
+def test_fused_matches_host_matcher(corpus):
+    shards, _d, findex = corpus
+    for sid, shard in enumerate(shards):
+        spec = _specs(shard, 1, seed=99 + sid)[0]
+        res = run_queries(
+            findex,
+            encode_queries([spec], shard_ids=[sid]),
+            window_cap=1024,
+            record_cap=512,
+        )
+        assert not res.overflow[0]
+        rows = findex.to_local_rows(res.rows[0][res.rows[0] >= 0], sid)
+        np.testing.assert_array_equal(rows, host_match_rows(shard, spec))
+
+
+def test_fused_overflow_flag_per_query(corpus):
+    """A window-overflowing spec flags ONLY its own lane; siblings in
+    the same fused launch stay exact."""
+    shards, _d, findex = corpus
+    wide = QuerySpec("1", 1, 1 << 29, 1, 1 << 30, alternate_bases="N")
+    narrow = _specs(shards[1], 1, seed=7)[0]
+    res = run_queries(
+        findex,
+        encode_queries([wide, narrow], shard_ids=[0, 1]),
+        window_cap=64,  # well under 300 rows -> overflow for `wide`
+        record_cap=64,
+    )
+    assert bool(res.overflow[0])
+    assert not bool(res.overflow[1])
+
+
+def test_submit_many_one_launch_and_row_slices(corpus):
+    """submit_many rides the whole multi-shard submission in ONE launch
+    and hands back one row per spec, in order."""
+    shards, dindexes, findex = corpus
+    specs = [_specs(s, 1, seed=13 + i)[0] for i, s in enumerate(shards)]
+    mb = MicroBatcher(max_batch=64, max_wait_ms=0)
+    try:
+        res = mb.submit_many(
+            findex,
+            specs,
+            shard_ids=[0, 1, 2, 3],
+            window_cap=256,
+            record_cap=64,
+        )
+        occ = mb.occupancy()
+        assert occ["launches"] == 1
+        assert occ["submits"] == 1 and occ["specs"] == 4
+        assert occ["fused_hist"] == {4: 1}
+        assert len(res.exists) == 4
+        for i, (spec, sid) in enumerate(zip(specs, [0, 1, 2, 3])):
+            one = run_queries(
+                dindexes[sid], [spec], window_cap=256, record_cap=64
+            )
+            assert bool(res.exists[i]) == bool(one.exists[0])
+            assert int(res.call_count[i]) == int(one.call_count[0])
+    finally:
+        mb.close()
+
+
+def test_cross_dataset_submits_share_accumulator(corpus):
+    """Concurrent single-spec submits for DIFFERENT shards coalesce
+    into shared launches on the fused index — the cross-accumulator
+    coalescing per-shard accumulators could never do."""
+    shards, _d, findex = corpus
+    mb = MicroBatcher(max_batch=64, max_wait_ms=25)
+    n = 16
+    results = [None] * n
+    errs = []
+
+    def worker(i):
+        sid = i % 4
+        spec = _specs(shards[sid], 1, seed=300 + i)[0]
+        try:
+            results[i] = (
+                sid,
+                spec,
+                mb.submit(
+                    findex,
+                    spec,
+                    shard_id=sid,
+                    window_cap=256,
+                    record_cap=64,
+                ),
+            )
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [
+        threading.Thread(target=worker, args=(i,)) for i in range(n)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    occ = mb.occupancy()
+    assert occ["submits"] == n
+    assert occ["launches"] < n  # coalescing engaged
+    for item in results:
+        assert item is not None
+        sid, spec, res = item
+        rows = res.rows[0][res.rows[0] >= 0]
+        rows = findex.to_local_rows(rows, sid)
+        np.testing.assert_array_equal(
+            rows, host_match_rows(shards[sid], spec)
+        )
+    mb.close()

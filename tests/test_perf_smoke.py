@@ -1,0 +1,121 @@
+"""Performance-contract smoke tests (perf_smoke marker, tier-1 fast).
+
+These assert the two launch-count invariants the fused-dispatch /
+response-cache overhaul exists to provide, on the CPU backend in
+seconds: a k-shard query is ONE kernel launch (not k), and a warm
+cache hit is ZERO launches. They are contracts, not benchmarks — the
+timing claims live in bench.py.
+"""
+
+import random
+
+import pytest
+
+import sbeacon_tpu.ops.kernel as kernel_mod
+from sbeacon_tpu.config import BeaconConfig, EngineConfig
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+N_SHARDS = 4
+
+
+def _engine(**eng_over):
+    cfg = BeaconConfig(
+        engine=EngineConfig(use_mesh=False, microbatch_wait_ms=0.0, **eng_over)
+    )
+    eng = VariantEngine(cfg)
+    shards = []
+    for d in range(N_SHARDS):
+        rng = random.Random(40 + d)
+        recs = random_records(rng, chrom="1", n=250, n_samples=2)
+        s = build_index(
+            recs,
+            dataset_id=f"d{d}",
+            vcf_location=f"v{d}",
+            sample_names=["S0", "S1"],
+        )
+        shards.append(s)
+        eng.add_index(s)
+    return eng, shards
+
+
+def _payload():
+    return VariantQueryPayload(
+        dataset_ids=[f"d{d}" for d in range(N_SHARDS)],
+        reference_name="1",
+        start_min=1,
+        start_max=1 << 29,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        requested_granularity="count",
+        include_datasets="HIT",
+    )
+
+
+def _launches() -> int:
+    # both kernel families count: XLA gather (CPU tier-1) + scatter tiles
+    from sbeacon_tpu.ops import scatter_kernel
+
+    return kernel_mod.N_LAUNCHES + scatter_kernel.N_DISPATCHES
+
+
+@pytest.mark.perf_smoke
+def test_multi_shard_query_is_one_fused_launch():
+    """A 4-shard query must issue exactly ONE device launch through the
+    fused stacked index (pre-overhaul: one per shard), with per-dataset
+    responses intact."""
+    eng, shards = _engine()
+    try:
+        eng.warmup()  # compiles outside the measured window
+        n0 = _launches()
+        responses = eng.search(_payload())
+        n1 = _launches()
+        assert n1 - n0 == 1, f"expected 1 fused launch, saw {n1 - n0}"
+        assert eng.fused_searches == 1
+        assert [r.dataset_id for r in responses] == [
+            f"d{d}" for d in range(N_SHARDS)
+        ]
+        assert all(r.exists for r in responses)
+    finally:
+        eng.close()
+
+
+@pytest.mark.perf_smoke
+def test_warm_cache_hit_is_zero_launches():
+    """A repeated query must be served from the response cache without
+    touching the device at all."""
+    eng, _shards = _engine()
+    try:
+        eng.warmup()
+        first = eng.search(_payload())
+        n0 = _launches()
+        again = eng.search(_payload())
+        n1 = _launches()
+        assert n1 - n0 == 0, f"cache hit dispatched {n1 - n0} launches"
+        stats = eng.cache_stats()
+        assert stats is not None and stats["hits"] >= 1
+        assert [(r.dataset_id, r.call_count, r.exists) for r in first] == [
+            (r.dataset_id, r.call_count, r.exists) for r in again
+        ]
+    finally:
+        eng.close()
+
+
+@pytest.mark.perf_smoke
+def test_cache_disabled_still_fuses():
+    """response_cache=False keeps the fused single-launch contract and
+    re-executes repeats (no stale shortcuts)."""
+    eng, _shards = _engine(response_cache=False)
+    try:
+        eng.warmup()
+        assert eng.cache_stats() is None
+        n0 = _launches()
+        eng.search(_payload())
+        eng.search(_payload())
+        n1 = _launches()
+        assert n1 - n0 == 2
+    finally:
+        eng.close()

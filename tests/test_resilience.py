@@ -366,6 +366,37 @@ def test_leader_hands_off_backlog_once_served(dindex, monkeypatch):
 
 
 @resilience
+def test_batcher_leader_bounded_on_wedged_fetch(dindex, monkeypatch):
+    """The async launch/fetch split adds a second stage that can wedge
+    (device_get never returning): the leader's wait must be bounded
+    there too, and the accumulator must recover once the fetch frees."""
+    import sbeacon_tpu.ops.kernel as kernel_mod
+    from sbeacon_tpu.serving import MicroBatcher
+
+    shard, di = dindex
+    spec = _spec(shard)
+    release = threading.Event()
+    orig = kernel_mod.PendingQueryResults.fetch
+
+    def wedged(self):
+        assert release.wait(15), "test deadlock"
+        return orig(self)
+
+    monkeypatch.setattr(kernel_mod.PendingQueryResults, "fetch", wedged)
+    mb = MicroBatcher(max_batch=8, max_wait_ms=0)
+    t0 = time.perf_counter()
+    with pytest.raises(BatchTimeout):
+        mb.submit(di, spec, window_cap=256, record_cap=64, timeout_s=0.3)
+    assert time.perf_counter() - t0 < 5.0
+    release.set()
+    monkeypatch.setattr(kernel_mod.PendingQueryResults, "fetch", orig)
+    time.sleep(0.3)  # drain the background fetch
+    got = mb.submit(di, spec, window_cap=256, record_cap=64)
+    assert got.exists is not None  # accumulator fully recovered
+    mb.close()
+
+
+@resilience
 def test_batcher_refuses_launch_for_expired_batch(dindex):
     """A batch whose every member is already past its deadline must not
     launch at all — and each waiter gets DeadlineExceeded."""

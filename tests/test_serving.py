@@ -203,7 +203,7 @@ def test_leader_death_releases_leadership_and_fails_followers(dindex):
 
     orig = MicroBatcher._execute
 
-    def exploding(self, batch, dindex_, window_cap, record_cap):
+    def exploding(self, acc, batch, dindex_, window_cap, record_cap):
         raise Boom("leader died")
 
     MicroBatcher._execute = exploding
