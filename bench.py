@@ -59,6 +59,11 @@ V5E_HBM_PEAK_GBPS = 819.0
 
 ALL_CHROMS = [str(i) for i in range(1, 23)]
 
+#: telemetry snapshot (request-latency histogram, stage quantiles,
+#: slow-query count) captured by the soak config and re-emitted with
+#: every cumulative BENCH record — see emit()
+_TELEMETRY: dict = {}
+
 
 def _time_batch(fn, repeats=REPEATS):
     times = []
@@ -1070,6 +1075,25 @@ def config9_soak(shard, sindex):
             requests_per_client=25,
             engine=app.engine,
         )
+        # telemetry-plane snapshot (ISSUE 4): the typed registry's
+        # request-latency histogram + stage quantiles + slow-query
+        # count ride in every BENCH record via _TELEMETRY, so the
+        # perf trajectory carries the decomposition, not just totals
+        tj = app.telemetry.render_json()
+        _TELEMETRY.update(
+            request_latency_ms=tj.get("request", {}).get("latency_ms", {}),
+            slow_queries=tj.get("request", {}).get("slow_queries", 0),
+            stage_quantiles={
+                k: tj.get("batcher", {}).get(k, {})
+                for k in (
+                    "queue_wait_ms",
+                    "exec_ms",
+                    "encode_ms",
+                    "launch_ms",
+                    "fetch_ms",
+                )
+            },
+        )
         # repeated-query (cache-hit) path: the fingerprint-keyed
         # response cache must serve a warm repeat from host memory —
         # zero device launches, sub-ms p50 (ISSUE 2 acceptance bar)
@@ -1189,6 +1213,8 @@ def main() -> None:
         enough that no tail window can cut it."""
         detail["bench_wall_s"] = round(time.monotonic() - _T_START, 1)
         detail["partial"] = not final
+        if _TELEMETRY:
+            detail["telemetry"] = _TELEMETRY
         record = {
             "metric": "batched_point_queries_single_chip_20M_rows",
             "value": round(headline["qps"], 1),

@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import hmac
 import math
+import time
 from pathlib import Path
 
 from ..config import BeaconConfig, StorageConfig
@@ -36,6 +37,16 @@ from ..resilience import (
     Deadline,
     ResilienceError,
     deadline_scope,
+    register_admission_metrics,
+    register_breaker_metrics,
+)
+from ..telemetry import (
+    MetricsRegistry,
+    RequestContext,
+    SlowQueryLog,
+    profiler,
+    request_context,
+    sanitize_trace_id,
 )
 from ..utils.trace import span, tracer
 from .envelopes import Envelopes
@@ -205,6 +216,34 @@ class BeaconApp:
         # readiness flag: constructed apps are servable; a deployment
         # may clear it during reload/drain so load balancers back off
         self.ready = True
+        # telemetry plane (telemetry.py): one typed-metrics registry per
+        # app — every producer registers its instruments here and
+        # /metrics renders the registry (JSON or Prometheus text)
+        # instead of hand-assembling nested dicts
+        self.telemetry = MetricsRegistry()
+        obs = self.config.observability
+        self.slow_log = SlowQueryLog(
+            threshold_ms=obs.slow_query_ms, path=obs.slow_query_log
+        )
+        if obs.profile_dir:
+            # config-armed profiling (the env var SBEACON_PROFILE sets
+            # the same field at import); first profiled region starts
+            # the jax trace capture. The profiler is process-global
+            # (jax supports one capture per process), so a second app
+            # cannot redirect an already-armed capture — warn instead
+            # of silently dropping the request.
+            if not profiler.directory:
+                profiler.directory = obs.profile_dir
+            elif profiler.directory != obs.profile_dir:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "profiling already armed for %s; ignoring "
+                    "profile_dir=%s (one capture per process)",
+                    profiler.directory,
+                    obs.profile_dir,
+                )
+        self._register_metrics()
         # mutating-route auth (reference /submit is AWS_IAM-gated,
         # api.tf:120-149): explicit verifier > config token > open (dev)
         if auth_verifier is not None:
@@ -224,6 +263,68 @@ class BeaconApp:
         self.query_runner.close()
         self.query_jobs.close()
 
+    # -- telemetry wiring ---------------------------------------------------
+
+    def _register_metrics(self) -> None:
+        """Wire every producer's typed instruments into this app's
+        registry. Suppliers read through ``self`` so components swapped
+        at runtime (tests replace ``app.admission``) stay observable."""
+        reg = self.telemetry
+        # request-level series owned by the app itself
+        self._req_latency = reg.histogram(
+            "request.latency_ms",
+            "end-to-end request latency per route",
+            label="route",
+        )
+        reg.counter(
+            "request.slow_queries",
+            "requests recorded by the slow-query log",
+            fn=lambda: self.slow_log.count(),
+        )
+        register_admission_metrics(reg, lambda: self.admission)
+        self.query_runner.register_metrics(reg)
+        engine_reg = getattr(self.engine, "register_metrics", None)
+        if engine_reg is not None:
+            engine_reg(reg)
+        if "breaker.state" not in reg.names():
+            # single-host engines have no worker routes; the series
+            # still exist (empty) so the catalogue is deployment-stable
+            register_breaker_metrics(
+                reg, lambda: getattr(self.engine, "breaker", None)
+            )
+
+    #: bounded route-label set for the latency histogram — unknown
+    #: paths collapse to "other" so a URL scanner cannot mint series
+    _ROUTE_HEADS = ENTITY_PATHS | {
+        "info",
+        "configuration",
+        "map",
+        "entry_types",
+        "filtering_terms",
+        "schemas",
+        "submit",
+        "g_variants",
+        "health",
+        "ready",
+        "metrics",
+        "_trace",
+    }
+
+    def _route_label(self, path: str) -> str:
+        parts = [p for p in path.strip("/").split("/") if p]
+        if not parts:
+            return "info"
+        head = parts[0]
+        if head not in self._ROUTE_HEADS:
+            return "other"
+        if len(parts) == 1:
+            return head
+        sub = parts[-1]
+        if sub in ("filtering_terms", "g_variants", "biosamples",
+                   "individuals", "runs", "analyses"):
+            return f"{head}.{sub}"
+        return f"{head}.id"
+
     # -- transport-facing entry --------------------------------------------
 
     def handle(
@@ -233,6 +334,41 @@ class BeaconApp:
         query_params: dict | None = None,
         body: dict | None = None,
         headers: dict | None = None,
+    ) -> tuple[int, dict]:
+        """One request end to end, under a request context: a trace id
+        minted here (or honored from an inbound ``X-Beacon-Trace``
+        header) rides every hop — spans, pool hand-offs, worker HTTP
+        calls — and returns in the response envelope's ``meta`` next to
+        the elapsed time (the reference's VariantQuery start/end/
+        elapsedTime columns, with propagated identity)."""
+        t0 = time.perf_counter()
+        route = self._route_label(path)
+        ctx = RequestContext(
+            trace_id=sanitize_trace_id(_header(headers, "x-beacon-trace")),
+            route=route,
+        )
+        with request_context(ctx):
+            status, payload = self._handle(
+                method, path, query_params, body, headers
+            )
+        elapsed_ms = (time.perf_counter() - t0) * 1e3
+        self._req_latency.observe(elapsed_ms, label_value=route)
+        self.slow_log.maybe_record(
+            trace_id=ctx.trace_id,
+            route=route,
+            status=status,
+            elapsed_ms=elapsed_ms,
+            notes=ctx.notes,
+        )
+        if isinstance(payload, dict):
+            meta = payload.get("meta")
+            if isinstance(meta, dict):
+                meta["traceId"] = ctx.trace_id
+                meta["elapsedTimeMs"] = round(elapsed_ms, 2)
+        return status, payload
+
+    def _handle(
+        self, method, path, query_params, body, headers
     ) -> tuple[int, dict]:
         try:
             with span("api.handle", path=path, method=method):
@@ -245,7 +381,7 @@ class BeaconApp:
                     # probes/metrics bypass auth, admission AND
                     # deadlines: they must answer while the server is
                     # saturated or shedding — that is their whole job
-                    return self._probe(head)
+                    return self._probe(head, query_params, headers)
                 denied = self._check_auth(method.upper(), path, headers)
                 if denied is not None:
                     return denied
@@ -294,7 +430,12 @@ class BeaconApp:
             return NO_DEADLINE
         return Deadline.after(self.config.resilience.default_deadline_s)
 
-    def _probe(self, head: str) -> tuple[int, dict]:
+    def _probe(
+        self,
+        head: str,
+        query_params: dict | None = None,
+        headers: dict | None = None,
+    ) -> tuple[int, dict]:
         info = self.config.info
         if head == "health":
             # liveness: cheap, no store/engine access
@@ -310,36 +451,23 @@ class BeaconApp:
                 "inFlight": self.admission.metrics()["in_flight"],
             }
             return (200 if self.ready else 503), body
+        # /metrics: content negotiation — ?format=prometheus or
+        # ``Accept: text/plain`` gets the exposition text (the transport
+        # serves str payloads as text/plain), everything else the
+        # back-compat nested JSON
+        fmt = (query_params or {}).get("format", "")
+        accept = _header(headers, "accept") or ""
+        if fmt == "prometheus" or "text/plain" in accept:
+            return 200, self.telemetry.render_prometheus()
         return 200, self._metrics()
 
     def _metrics(self) -> dict:
-        """Serving observability: admission, runner pool, batcher
-        occupancy (incl. launcher/fetcher pool depth and the
-        fused-batch histogram under their stable keys inside
-        ``batcher``), response-cache counters, per-worker breaker
-        states, armed fault plan."""
-        out: dict = {
-            "admission": self.admission.metrics(),
-            "runner": self.query_runner.metrics(),
-        }
-        local = getattr(self.engine, "local", None) or self.engine
-        batcher = getattr(self.engine, "_batcher", None) or getattr(
-            local, "_batcher", None
-        )
-        if batcher is not None:
-            out["batcher"] = batcher.occupancy()
-        cache_stats = getattr(local, "cache_stats", None)
-        if callable(cache_stats):
-            stats = cache_stats()
-            if stats is not None:
-                out["response_cache"] = stats
-        if hasattr(local, "fused_searches"):
-            # unconditional (stable keys): dashboards must see the
-            # series at 0, not have it flap into existence
-            out["engine"] = {
-                "fused_searches": local.fused_searches,
-                "mesh_searches": local.mesh_searches,
-            }
+        """Serving observability: the typed-instrument registry rendered
+        as nested JSON (``admission``, ``runner``, ``batcher``,
+        ``response_cache``, ``engine``, ``request`` under their stable
+        keys), plus the two surfaces kept in their historical non-dotted
+        shapes — per-worker breaker states and the armed fault plan."""
+        out = self.telemetry.render_json()
         breaker = getattr(self.engine, "breaker", None)
         if breaker is not None:
             out["breaker"] = breaker.metrics()
@@ -405,7 +533,15 @@ class BeaconApp:
                 # debug-only profiling surface; 404s unless tracing is on
                 if not tracer.is_enabled:
                     return 404, self.env.error(404, "tracing disabled")
-                return 200, {"report": tracer.report()}
+                # recent span trees (structured, trace ids attached) +
+                # the aggregate report + the slow-query ring; ?trace_id=
+                # filters the trees to one distributed request
+                want = (query_params or {}).get("trace_id")
+                return 200, {
+                    "report": tracer.report(),
+                    "traces": tracer.recent_trees(trace_id=want),
+                    "slowQueries": self.slow_log.recent(),
+                }
             if head == "configuration":
                 return 200, configuration_response(info)
             if head == "map":

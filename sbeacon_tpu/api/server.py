@@ -46,10 +46,17 @@ def _make_handler(app: BeaconApp):
             )
             self._send(status, payload)
 
-        def _send(self, status: int, payload: dict):
-            data = json.dumps(payload).encode()
+        def _send(self, status: int, payload):
+            if isinstance(payload, str):
+                # text payloads (Prometheus exposition from /metrics)
+                # go out verbatim as text/plain
+                data = payload.encode()
+                content_type = "text/plain; version=0.0.4"
+            else:
+                data = json.dumps(payload).encode()
+                content_type = "application/json"
             self.send_response(status)
-            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Type", content_type)
             self.send_header("Access-Control-Allow-Origin", "*")
             retry_after = (
                 payload.get("retryAfterSeconds")
@@ -73,7 +80,11 @@ def _make_handler(app: BeaconApp):
                 "Access-Control-Allow-Methods", "GET, POST, PATCH, OPTIONS"
             )
             self.send_header(
-                "Access-Control-Allow-Headers", "Content-Type, Authorization"
+                "Access-Control-Allow-Headers",
+                # the client-settable request headers DEPLOYMENT.md
+                # documents: auth, per-request deadline, trace id
+                "Content-Type, Authorization, X-Beacon-Deadline, "
+                "X-Beacon-Trace",
             )
             self.send_header("Content-Length", "0")
             self.end_headers()

@@ -238,6 +238,27 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class ObservabilityConfig:
+    """Telemetry-plane knobs (telemetry.py). Tracing itself stays
+    env-gated (``SBEACON_TRACE=1``, utils/trace.py) like the
+    reference's ``#define INCLUDE_STOP_WATCH``; these knobs cover the
+    always-on surfaces built on top of it.
+
+    slow_query_ms: any request slower than this emits one structured
+      JSON line (trace id, route, stage notes) to the
+      ``sbeacon.slowquery`` logger and the in-memory ring served at
+      ``/_trace``. 0 records every request (debug); negative disables.
+    slow_query_log: optional file the slow-query JSON lines append to.
+    profile_dir: arms ``jax.profiler`` capture of kernel launch/fetch
+      regions into this directory (the ``SBEACON_PROFILE`` env var).
+    """
+
+    slow_query_ms: float = 1000.0
+    slow_query_log: str = ""
+    profile_dir: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
 class AuthConfig:
     """Authentication for the two trust boundaries the reference gates
     with IAM: the mutating ``/submit`` route (reference: api.tf:120-149,
@@ -268,6 +289,9 @@ class BeaconConfig:
     auth: AuthConfig = dataclasses.field(default_factory=AuthConfig)
     resilience: ResilienceConfig = dataclasses.field(
         default_factory=ResilienceConfig
+    )
+    observability: ObservabilityConfig = dataclasses.field(
+        default_factory=ObservabilityConfig
     )
 
     @staticmethod
@@ -368,6 +392,14 @@ class BeaconConfig:
             if var in env:
                 res_over[field] = conv(env[var])
         resilience = ResilienceConfig(**res_over)
+        obs_over: dict = {}
+        if "SBEACON_SLOW_QUERY_MS" in env:
+            obs_over["slow_query_ms"] = float(env["SBEACON_SLOW_QUERY_MS"])
+        if "SBEACON_SLOW_QUERY_LOG" in env:
+            obs_over["slow_query_log"] = env["SBEACON_SLOW_QUERY_LOG"]
+        if "SBEACON_PROFILE" in env:
+            obs_over["profile_dir"] = env["SBEACON_PROFILE"]
+        observability = ObservabilityConfig(**obs_over)
         return BeaconConfig(
             info=info,
             storage=storage,
@@ -376,6 +408,7 @@ class BeaconConfig:
             resolvers=resolvers,
             auth=auth,
             resilience=resilience,
+            observability=observability,
         )
 
     def dumps(self) -> str:

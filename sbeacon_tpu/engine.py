@@ -39,6 +39,7 @@ from .ops import make_device_index, run_queries_auto
 from .ops.kernel import QuerySpec, encode_queries
 from .payloads import VariantQueryPayload, VariantSearchResponse
 from .response_cache import ResponseCache, response_cache_key
+from .telemetry import annotate
 from .utils.chrom import chromosome_code
 from .utils.trace import span
 
@@ -985,7 +986,9 @@ class VariantEngine:
             key = response_cache_key(self.index_fingerprint(), payload)
             hit = cache.get(key)
             if hit is not None:
+                annotate(response_cache="hit")
                 return hit
+        annotate(response_cache="miss" if cache is not None else "off")
         with span("engine.search") as sp:
             responses = self._search(payload, sp)
         if key is not None:
@@ -999,6 +1002,33 @@ class VariantEngine:
             if self._response_cache is None
             else self._response_cache.stats()
         )
+
+    def register_metrics(self, registry) -> None:
+        """Register this engine's typed instruments — its own dispatch
+        counters and stage quantiles, plus the batcher's and response
+        cache's (the producers each own their registration; this only
+        fans out to the components the engine wired)."""
+        from .response_cache import register_cache_metrics
+
+        registry.counter(
+            "engine.fused_searches",
+            "multi-dataset queries answered by one fused launch",
+            fn=lambda: self.fused_searches,
+        )
+        registry.counter(
+            "engine.mesh_searches",
+            "queries answered by the one-pjit mesh path",
+            fn=lambda: self.mesh_searches,
+        )
+        registry.gauge(
+            "engine.materialize_ms",
+            "host materialisation quantiles",
+            label="quantile",
+            fn=lambda: self.stage_timing()["materialize_ms"],
+        )
+        if self._batcher is not None:
+            self._batcher.register_metrics(registry)
+        register_cache_metrics(registry, lambda: self._response_cache)
 
     def stage_timing(self) -> dict:
         """Host materialisation percentiles (the stage after the
@@ -1288,6 +1318,7 @@ class VariantEngine:
                 out[key] = findex.to_local_rows(rows, sid)
         with self._mat_lock:  # unlocked += would drop concurrent counts
             self.fused_searches += 1
+        annotate(dispatch="fused")
         return out
 
     def _search(self, payload: VariantQueryPayload, sp):
@@ -1721,6 +1752,7 @@ class VariantEngine:
         else:
             responses = list(self._scatter.map(_one, targets))
         self.mesh_searches += 1
+        annotate(dispatch="mesh")
         if selected_mesh:
             self.mesh_selected_searches += 1
         sp.note(

@@ -45,6 +45,15 @@ from ..resilience import (
     CircuitOpen,
     DeadlineExceeded,
     current_deadline,
+    register_breaker_metrics,
+)
+from ..telemetry import (
+    TRACE_HEADER,
+    RequestContext,
+    annotate,
+    current_context,
+    request_context,
+    sanitize_trace_id,
 )
 from ..utils.trace import span
 
@@ -148,7 +157,21 @@ def _make_handler(
                 payload = VariantQueryPayload(
                     **json.loads(self.rfile.read(n))
                 )
-                responses = engine.search(payload)
+                # adopt the coordinator's trace id (X-Beacon-Trace) so
+                # worker-side spans parent into the same distributed
+                # trace; a direct caller without the header gets a
+                # fresh worker-local id
+                ctx = RequestContext(
+                    trace_id=sanitize_trace_id(
+                        self.headers.get(TRACE_HEADER)
+                    ),
+                    route="worker.search",
+                )
+                with request_context(ctx), span(
+                    "worker.search",
+                    datasets=len(payload.dataset_ids or []),
+                ):
+                    responses = engine.search(payload)
                 self._send(
                     200,
                     {"responses": [json.loads(r.dumps()) for r in responses]},
@@ -453,6 +476,20 @@ class DistributedEngine:
         self.max_threads = max_threads
         self._post = post
         self._get = get
+        # does the (possibly injected) transport accept a 4th headers
+        # arg? Decided once here so the per-call path never plays
+        # TypeError roulette with a swapped gRPC/DCN transport
+        import inspect
+
+        try:
+            params = inspect.signature(post).parameters
+            self._post_takes_headers = len(params) >= 4 or any(
+                p.kind == inspect.Parameter.VAR_POSITIONAL
+                or p.kind == inspect.Parameter.VAR_KEYWORD
+                for p in params.values()
+            )
+        except (TypeError, ValueError):  # builtins/C callables
+            self._post_takes_headers = True
         # self.config is always resolved by now (explicit > local's >
         # default), so the token fallback must read it — reading the raw
         # `config` param would silently drop a token that arrived via
@@ -479,14 +516,24 @@ class DistributedEngine:
             max_workers=max_threads, thread_name_prefix="dispatch"
         )
 
-    # auth header is passed only when a token is configured, so injected
-    # test transports keep their 3-/2-arg signatures
+    # headers are passed only when there is something to carry (a
+    # configured token, an ambient trace id) AND the transport's
+    # signature accepts them — legacy 3-arg injected transports keep
+    # working, they just don't propagate the trace header. A token with
+    # a 3-arg transport still passes headers (auth is correctness; the
+    # loud TypeError beats silently-unauthenticated calls).
     def _post_auth(self, url: str, doc: dict, timeout_s: float):
+        headers: dict = {}
         if self._token:
-            return self._post(
-                url, doc, timeout_s,
-                {"Authorization": f"Bearer {self._token}"},
-            )
+            headers["Authorization"] = f"Bearer {self._token}"
+        ctx = current_context()
+        if ctx is not None and self._post_takes_headers:
+            # every coordinator->worker hop carries the request's trace
+            # id so worker-side spans share it (the Dapper propagation
+            # the reference's SNS fan-out never had)
+            headers[TRACE_HEADER] = ctx.trace_id
+        if headers:
+            return self._post(url, doc, timeout_s, headers)
         return self._post(url, doc, timeout_s)
 
     def _get_auth(self, url: str, timeout_s: float):
@@ -503,6 +550,15 @@ class DistributedEngine:
         shape the soak-tail fix skips."""
         warm = getattr(self.local, "warmup", None)
         return warm() if warm else 0
+
+    def register_metrics(self, registry) -> None:
+        """Coordinator telemetry: per-worker breaker series plus the
+        local engine's instruments (batcher, response cache, dispatch
+        counters) when one is wired."""
+        register_breaker_metrics(registry, lambda: self.breaker)
+        reg = getattr(self.local, "register_metrics", None)
+        if reg is not None:
+            reg(registry)
 
     def close(self) -> None:
         """Release the scatter pool (engines are long-lived; call this
@@ -585,11 +641,23 @@ class DistributedEngine:
     # -- query path ---------------------------------------------------------
 
     def _call_worker(
+        self, url: str, payload: VariantQueryPayload, deadline=None,
+        ctx=None,
+    ):
+        # the request context rides in explicitly like the deadline
+        # (pool thread: the submitting request's thread-locals are not
+        # visible) and is re-installed so the trace header and outcome
+        # notes work from here down
+        with request_context(ctx if ctx is not None else current_context()):
+            return self._call_worker_traced(url, payload, deadline)
+
+    def _call_worker_traced(
         self, url: str, payload: VariantQueryPayload, deadline=None
     ):
         if not self.breaker.allow(url):
             # fast-fail: the route failed repeatedly and its reset
             # window hasn't lapsed — don't spend timeout_s finding out
+            annotate(breaker="open")
             raise CircuitOpen(f"worker {url}: circuit open")
         doc = json.loads(payload.dumps())
         # the request deadline is passed EXPLICITLY by search(): this
@@ -677,8 +745,9 @@ class DistributedEngine:
                 # pool (bounded by their own clamped urllib timeouts)
                 # and the caller gets DeadlineExceeded now.
                 deadline = current_deadline()
+                ctx = current_context()
                 futures = [
-                    self._pool.submit(self._call_worker, *t, deadline)
+                    self._pool.submit(self._call_worker, *t, deadline, ctx)
                     for t in tasks
                 ]
                 first_err: BaseException | None = None

@@ -44,6 +44,7 @@ from .resilience import (
     current_deadline,
     deadline_scope,
 )
+from .telemetry import annotate, current_context, request_context
 from .utils.trace import span
 
 
@@ -496,6 +497,30 @@ class AsyncQueryRunner:
             "shed": gate["shed"],
         }
 
+    def register_metrics(self, registry) -> None:
+        """The runner pool's typed instruments (its slice of the old
+        hand-assembled ``/metrics`` dict, now stable named series)."""
+        registry.gauge(
+            "runner.workers",
+            "async query runner pool size",
+            fn=lambda: self.workers,
+        )
+        registry.gauge(
+            "runner.max_pending",
+            "runner admission cap",
+            fn=lambda: self.max_pending,
+        )
+        registry.gauge(
+            "runner.active",
+            "queries executing or queued in the runner",
+            fn=lambda: self._gate.metrics()["in_flight"],
+        )
+        registry.counter(
+            "runner.shed",
+            "runner submissions shed with 429",
+            fn=lambda: self._gate.metrics()["shed"],
+        )
+
     def _maybe_purge(self) -> None:
         now = time.time()
         with self._lock:
@@ -544,13 +569,19 @@ class AsyncQueryRunner:
         with self._lock:
             hit = self._results.get(query_id)
         if hit is not None and hit[1] > time.time():
+            # job-layer outcome notes (telemetry): a repeat served here
+            # never reaches engine.search, so the slow-query log would
+            # otherwise show an unexplained fast request
+            annotate(query_job="memory_hit")
             return query_id, JobStatus.COMPLETED
         status = self.table.get_job_status(query_id)
         if status is JobStatus.COMPLETED:
+            annotate(query_job="table_hit")
             return query_id, status
         if status is JobStatus.RUNNING:
             # coalesce onto the in-flight execution — consumes no pool
             # slot, so it must happen before the capacity gate
+            annotate(query_job="coalesced")
             return query_id, status
         # reserve a pool slot BEFORE claiming: shedding after a claim
         # would leave the job RUNNING with nobody executing it, stalling
@@ -584,11 +615,17 @@ class AsyncQueryRunner:
         # check-point once the deadline lapses — worker calls clamp,
         # expired batches refuse to launch. A coalescer with a longer
         # deadline simply sees the abandoned job and falls back to a
-        # direct search under its own deadline.
+        # direct search under its own deadline. The request context
+        # (trace id + outcome notes) crosses the same way, so spans
+        # recorded on the pool thread — and the trace header on any
+        # coordinator->worker hop — keep the ingress trace id.
         job_deadline = current_deadline()
+        job_ctx = current_context()
 
         def run():
-            with span("query_jobs.run", query_id=query_id):
+            with request_context(job_ctx), span(
+                "query_jobs.run", query_id=query_id
+            ):
                 try:
                     with deadline_scope(job_deadline):
                         responses = self.engine.search(pl)

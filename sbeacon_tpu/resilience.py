@@ -222,6 +222,39 @@ class AdmissionController:
             }
 
 
+def register_admission_metrics(registry, supplier) -> None:
+    """Register the server admission gate's typed instruments.
+
+    ``supplier`` is a zero-arg callable returning the CURRENT
+    AdmissionController — the app may swap its controller at runtime
+    (tests do), so instruments must read through the owner, not bind
+    one instance."""
+
+    def field(name):
+        return lambda: supplier().metrics()[name]
+
+    registry.gauge(
+        "admission.max_in_flight",
+        "configured in-flight request cap",
+        fn=field("max_in_flight"),
+    )
+    registry.gauge(
+        "admission.in_flight",
+        "requests currently admitted",
+        fn=field("in_flight"),
+    )
+    registry.counter(
+        "admission.admitted",
+        "requests admitted since start",
+        fn=field("admitted"),
+    )
+    registry.counter(
+        "admission.shed",
+        "requests shed with 429 at the admission gate",
+        fn=field("shed"),
+    )
+
+
 # -- circuit breaker ----------------------------------------------------------
 
 CLOSED = "closed"
@@ -343,3 +376,51 @@ class CircuitBreaker:
                 }
                 for key, c in sorted(self._circuits.items())
             }
+
+
+#: numeric encoding of circuit states for gauge series (Prometheus
+#: cannot carry strings as values): closed=0, open=1, half_open=2
+BREAKER_STATE_CODE = {CLOSED: 0, OPEN: 1, HALF_OPEN: 2}
+
+
+def register_breaker_metrics(registry, supplier) -> None:
+    """Per-route circuit series. ``supplier`` returns the CircuitBreaker
+    (or None when the engine has no worker routes — the series then
+    render empty but the names stay registered, so dashboards never see
+    them flap in and out of existence). ``json_render=False`` keeps the
+    historical ``/metrics`` JSON shape — ``{route: {state, ...}}``,
+    overlaid by the app — while Prometheus gets typed labeled series."""
+
+    def per_route(field, code=None):
+        def collect():
+            b = supplier()
+            if b is None:
+                return {}
+            return {
+                route: (code[v[field]] if code else v[field])
+                for route, v in b.metrics().items()
+            }
+
+        return collect
+
+    registry.gauge(
+        "breaker.state",
+        "circuit state per worker route (0=closed 1=open 2=half_open)",
+        label="route",
+        json_render=False,
+        fn=per_route("state", BREAKER_STATE_CODE),
+    )
+    registry.gauge(
+        "breaker.consecutive_failures",
+        "consecutive failures per worker route",
+        label="route",
+        json_render=False,
+        fn=per_route("consecutive_failures"),
+    )
+    registry.counter(
+        "breaker.opens",
+        "lifetime open transitions per worker route",
+        label="route",
+        json_render=False,
+        fn=per_route("opens"),
+    )

@@ -157,3 +157,31 @@ class ResponseCache:
                 "expirations": self._expirations,
                 "invalidations": self._invalidations,
             }
+
+
+def register_cache_metrics(registry, supplier) -> None:
+    """Typed instruments over a ResponseCache. ``supplier`` returns the
+    cache or None (disabled) — disabled caches render zeros so the
+    series stay stable for dashboards."""
+
+    def field(name):
+        def collect():
+            cache = supplier()
+            return 0 if cache is None else cache.stats()[name]
+
+        return collect
+
+    registry.gauge("response_cache.entries", fn=field("entries"))
+    registry.gauge("response_cache.max_entries", fn=field("max_entries"))
+    registry.gauge("response_cache.ttl_s", fn=field("ttl_s"))
+    registry.gauge("response_cache.hit_rate", fn=field("hit_rate"))
+    registry.counter("response_cache.hits", fn=field("hits"))
+    registry.counter("response_cache.misses", fn=field("misses"))
+    registry.counter(
+        "response_cache.negative_hits", fn=field("negative_hits")
+    )
+    registry.counter("response_cache.evictions", fn=field("evictions"))
+    registry.counter("response_cache.expirations", fn=field("expirations"))
+    registry.counter(
+        "response_cache.invalidations", fn=field("invalidations")
+    )

@@ -39,6 +39,7 @@ from .resilience import (
     DeadlineExceeded,
     current_deadline,
 )
+from .telemetry import annotate, profile_region
 from .utils.trace import span
 
 
@@ -325,6 +326,12 @@ class MicroBatcher:
                     raise self._timeout_error(req_deadline)
         if me.error is not None:
             raise me.error
+        # per-request stage note for the slow-query log: submit ->
+        # result delivery (queue wait + device execute + fetch), the
+        # batcher's share of this request's latency
+        annotate(
+            batch_ms=round((time.perf_counter() - me.t_submit) * 1e3, 2)
+        )
         return me.result
 
     def _lead(
@@ -647,6 +654,127 @@ class MicroBatcher:
         out["fetcher"] = self._fetcher.depth()
         return out
 
+    def register_metrics(self, registry) -> None:
+        """Register this batcher's typed instruments (the occupancy /
+        timing dicts' contents, under their historical ``/metrics``
+        keys as dotted names). Collection reads the same
+        ``occupancy()`` / ``timing_summary()`` state the soak harness
+        consumes, so the two surfaces cannot drift.
+
+        The 17 instruments share ONE briefly-cached snapshot per
+        render pass: ``timing_summary()`` copies five timing rings
+        (up to ``timing_window`` floats each) and runs percentile
+        sorts under the hot-path stats lock — recomputing it per
+        instrument would make every Prometheus scrape contend with
+        request serving 17 times over."""
+        snap_lock = threading.Lock()
+        snap = {"t": 0.0, "occ": None, "timing": None}
+
+        def snapshot():
+            now = time.monotonic()
+            with snap_lock:
+                if snap["occ"] is None or now - snap["t"] > 0.25:
+                    snap["occ"] = self.occupancy()
+                    snap["timing"] = self.timing_summary()
+                    snap["t"] = now
+                return snap["occ"], snap["timing"]
+
+        def occ(*path):
+            def collect():
+                v = snapshot()[0]
+                for part in path:
+                    v = v[part]
+                return v
+
+            return collect
+
+        def hist(name):
+            return lambda: {
+                str(k): v for k, v in snapshot()[0][name].items()
+            }
+
+        def timing(name):
+            return lambda: snapshot()[1][name]
+
+        registry.counter(
+            "batcher.submits", "micro-batch submissions", fn=occ("submits")
+        )
+        registry.counter(
+            "batcher.specs", "flattened query specs", fn=occ("specs")
+        )
+        registry.counter(
+            "batcher.launches", "kernel launches", fn=occ("launches")
+        )
+        registry.gauge(
+            "batcher.mean_batch",
+            "mean submissions per launch",
+            fn=occ("mean_batch"),
+        )
+        registry.counter(
+            "batcher.expired",
+            "submits whose request deadline lapsed before launch",
+            fn=occ("expired"),
+        )
+        registry.counter(
+            "batcher.timeouts",
+            "submits that timed out waiting for a launch",
+            fn=occ("timeouts"),
+        )
+        registry.counter(
+            "batcher.histogram",
+            "launches by submissions-per-launch",
+            label="batch_size",
+            fn=hist("histogram"),
+        )
+        registry.counter(
+            "batcher.fused_hist",
+            "launches by flattened specs-per-launch",
+            label="specs_per_launch",
+            fn=hist("fused_hist"),
+        )
+        registry.gauge(
+            "batcher.launcher.threads", fn=occ("launcher", "threads")
+        )
+        registry.gauge(
+            "batcher.launcher.queued", fn=occ("launcher", "queued")
+        )
+        registry.gauge(
+            "batcher.fetcher.threads", fn=occ("fetcher", "threads")
+        )
+        registry.gauge(
+            "batcher.fetcher.queued", fn=occ("fetcher", "queued")
+        )
+        registry.gauge(
+            "batcher.queue_wait_ms",
+            "submit -> kernel launch wait quantiles",
+            label="quantile",
+            fn=timing("queue_wait_ms"),
+        )
+        registry.gauge(
+            "batcher.exec_ms",
+            "launch -> results quantiles",
+            label="quantile",
+            fn=timing("exec_ms"),
+        )
+        registry.gauge(
+            "batcher.encode_ms",
+            "host query-encode quantiles",
+            label="quantile",
+            fn=timing("encode_ms"),
+        )
+        registry.gauge(
+            "batcher.launch_ms",
+            "async kernel-dispatch quantiles",
+            label="quantile",
+            fn=timing("launch_ms"),
+        )
+        registry.gauge(
+            "batcher.fetch_ms",
+            "device execute + readback quantiles",
+            label="quantile",
+            fn=timing("fetch_ms"),
+        )
+
     def _execute(self, acc, batch, dindex, window_cap, record_cap):
         """LAUNCH stage (launcher thread): flatten the batch's specs,
         encode and dispatch ONE kernel launch, then hand the in-flight
@@ -677,7 +805,9 @@ class MicroBatcher:
             for p in batch:
                 self._wait_ms.append((t_launch - p.t_submit) * 1e3)
         try:
-            with span("serving.microbatch") as sp:
+            with span("serving.microbatch") as sp, profile_region(
+                "sbeacon.kernel.launch"
+            ):
                 # chaos site: a raised fault takes the existing
                 # launch-failure path (every waiter gets the error)
                 fault_point("kernel.launch")
@@ -731,7 +861,8 @@ class MicroBatcher:
         """FETCH stage (fetcher thread): block on the device results,
         hand each submission its row-slice, release the pipeline slot."""
         try:
-            res = pending.fetch()
+            with profile_region("sbeacon.kernel.fetch"):
+                res = pending.fetch()
             t_done = time.perf_counter()
             with self._stats_lock:
                 exec_ms = (t_done - t_launch) * 1e3

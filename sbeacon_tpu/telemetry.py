@@ -1,0 +1,530 @@
+"""Unified telemetry plane: metrics registry, request tracing, profiling.
+
+The reference's only observability is a compile-gated C++ stopwatch
+(reference: lambda/summariseSlice/source/stopwatch.h) and
+print-to-CloudWatch logging; its request-identity story is the
+``VariantQuery.startTime/endTime/elapsedTime`` DynamoDB columns
+(shared_resources/dynamodb/variant_queries.py:29-59) — timing without a
+propagated identity. After PR 1-2 this repo's own telemetry had
+fragmented the same way: ``/metrics`` hand-assembled nested dicts from
+the batcher, admission controller, breakers and response cache, and the
+``Tracer`` in ``utils/trace.py`` was process-local with no request id
+crossing the coordinator->worker HTTP boundary.
+
+This module is the single plane the stack wires through:
+
+- **Metrics registry** (:class:`MetricsRegistry`): typed
+  :class:`Counter` / :class:`Gauge` / :class:`Histogram` instruments
+  with stable dotted names and optional one-label fan-out. Producers
+  register instruments (value-owning or callback-backed, the Prometheus
+  collector style — the callback reads state the producer already
+  maintains under its own lock); the registry renders one snapshot as
+  nested JSON (back-compat with the old hand-assembled ``/metrics``
+  shape) or as Prometheus text exposition.
+- **Request context** (:class:`RequestContext`): a trace id minted at
+  API ingress (or honored from an inbound ``X-Beacon-Trace`` header),
+  carried thread-locally and re-installed across the pool hand-offs the
+  batcher and async runner already do for deadlines, propagated as a
+  header on every coordinator->worker call so worker-side spans parent
+  correctly (the Dapper model), and returned in the response envelope.
+- **Profiling + slow-query hooks**: ``SBEACON_PROFILE=<dir>`` arms
+  :func:`profile_region` so kernel launch/fetch run under
+  ``jax.profiler`` trace annotations; :class:`SlowQueryLog` records a
+  structured JSON line (trace id, route, stage decomposition, outcome
+  notes) for every request above a configurable latency threshold.
+
+Everything here is stdlib-only (jax is imported lazily and only when
+profiling is armed) and importable from any layer, like resilience.py.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import threading
+import time
+import uuid
+from contextlib import contextmanager
+
+log = logging.getLogger(__name__)
+
+# -- metric instruments -------------------------------------------------------
+
+#: fixed request/stage latency bucket upper bounds, in milliseconds
+#: (Prometheus-style cumulative buckets; +Inf is implicit)
+LATENCY_BUCKETS_MS = (
+    1.0, 2.5, 5.0, 10.0, 25.0, 50.0, 100.0,
+    250.0, 500.0, 1000.0, 2500.0, 5000.0, 10000.0,
+)
+
+#: instrument names are stable dotted lowercase identifiers —
+#: ``tools/check_metric_names.py`` enforces the same grammar statically
+_NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+
+class _Instrument:
+    """Shared base: a named, optionally labeled, typed series.
+
+    ``fn`` makes the instrument callback-backed (collector style): the
+    callback returns the current value — a number, or a
+    ``{label_value: number}`` dict when ``label`` is set. Without
+    ``fn`` the instrument owns its value(s) under a short lock.
+    """
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", *,
+                 fn=None, label: str | None = None, json_render: bool = True):
+        if not _NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} must be dotted lowercase "
+                "(e.g. 'batcher.launches')"
+            )
+        self.name = name
+        self.help = help
+        self.fn = fn
+        self.label = label
+        #: False = Prometheus-only (used where the back-compat JSON
+        #: shape differs from the dotted nesting, e.g. breaker state)
+        self.json_render = json_render
+        self._lock = threading.Lock()
+        self._value = 0.0
+        self._children: dict[str, float] = {}
+
+    def _bump(self, n: float, label_value: str | None) -> None:
+        with self._lock:
+            if label_value is None:
+                self._value += n
+            else:
+                self._children[label_value] = (
+                    self._children.get(label_value, 0.0) + n
+                )
+
+    def collect(self):
+        """Current value: a number, or {label_value: number}."""
+        if self.fn is not None:
+            try:
+                return self.fn()
+            except Exception:  # a broken callback must not kill /metrics
+                log.exception("metric %s callback failed", self.name)
+                return None
+        with self._lock:
+            if self.label is not None:
+                return dict(self._children)
+            return self._value
+
+
+class Counter(_Instrument):
+    """Monotonic cumulative count (requests served, cache hits)."""
+
+    kind = "counter"
+
+    def inc(self, n: float = 1.0, *, label_value: str | None = None) -> None:
+        self._bump(n, label_value)
+
+
+class Gauge(_Instrument):
+    """Point-in-time level (queue depth, entries resident)."""
+
+    kind = "gauge"
+
+    def set(self, v: float, *, label_value: str | None = None) -> None:
+        with self._lock:
+            if label_value is None:
+                self._value = float(v)
+            else:
+                self._children[label_value] = float(v)
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket latency histogram with per-label-value children.
+
+    ``observe`` is the hot-path entry: one short lock, one linear
+    bucket scan over the fixed boundary tuple (13 compares) — no
+    allocation. Buckets are cumulative at render time, Prometheus
+    semantics.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "", *,
+                 buckets: tuple = LATENCY_BUCKETS_MS,
+                 label: str | None = None):
+        super().__init__(name, help, label=label)
+        self.buckets = tuple(float(b) for b in buckets)
+        # label_value (or "") -> [counts per bucket + overflow, count, sum]
+        self._series: dict[str, list] = {}
+
+    def observe(self, v: float, *, label_value: str | None = None) -> None:
+        key = label_value if label_value is not None else ""
+        with self._lock:
+            s = self._series.get(key)
+            if s is None:
+                s = self._series[key] = [
+                    [0] * (len(self.buckets) + 1), 0, 0.0
+                ]
+            counts, _n, _sum = s
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            s[1] += 1
+            s[2] += v
+
+    def collect(self):
+        """{label_value: {"count", "sum", "buckets": {le: cumulative}}}
+        (unlabeled histograms use the single key ``""``)."""
+        out = {}
+        with self._lock:
+            for key, (counts, n, total) in self._series.items():
+                cum, acc = {}, 0
+                for b, c in zip(self.buckets, counts):
+                    acc += c
+                    cum[f"{b:g}"] = acc
+                cum["+Inf"] = acc + counts[-1]
+                out[key] = {
+                    "count": n,
+                    "sum": round(total, 3),
+                    "buckets": cum,
+                }
+        return out
+
+
+class MetricsRegistry:
+    """One process surface of typed series with stable dotted names.
+
+    Registration raises on duplicates so renames/collisions break at
+    wiring time (and in CI via ``tools/check_metric_names.py``), not
+    silently on a dashboard.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: dict[str, _Instrument] = {}
+
+    def _register(self, inst: _Instrument) -> _Instrument:
+        with self._lock:
+            if inst.name in self._instruments:
+                raise ValueError(f"metric {inst.name!r} already registered")
+            self._instruments[inst.name] = inst
+        return inst
+
+    def counter(self, name: str, help: str = "", *,
+                fn=None, label: str | None = None,
+                json_render: bool = True) -> Counter:
+        return self._register(
+            Counter(name, help, fn=fn, label=label, json_render=json_render)
+        )
+
+    def gauge(self, name: str, help: str = "", *,
+              fn=None, label: str | None = None,
+              json_render: bool = True) -> Gauge:
+        return self._register(
+            Gauge(name, help, fn=fn, label=label, json_render=json_render)
+        )
+
+    def histogram(self, name: str, help: str = "", *,
+                  buckets: tuple = LATENCY_BUCKETS_MS,
+                  label: str | None = None) -> Histogram:
+        return self._register(Histogram(name, help, buckets=buckets,
+                                        label=label))
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._instruments)
+
+    def _snapshot(self) -> list[_Instrument]:
+        with self._lock:
+            return [self._instruments[k] for k in sorted(self._instruments)]
+
+    # -- renderings ----------------------------------------------------------
+
+    def render_json(self) -> dict:
+        """Nested-by-dots snapshot: ``batcher.launcher.queued`` renders
+        as ``{"batcher": {"launcher": {"queued": N}}}`` — the exact
+        shape the old hand-assembled ``/metrics`` dict had, so
+        dashboards and tests keep their keys."""
+        out: dict = {}
+        for inst in self._snapshot():
+            if not inst.json_render:
+                continue
+            val = inst.collect()
+            if val is None:
+                continue
+            if inst.kind == "histogram" and isinstance(val, dict):
+                # unlabel single-series histograms for readability
+                if set(val) == {""}:
+                    val = val[""]
+            node = out
+            parts = inst.name.split(".")
+            for p in parts[:-1]:
+                node = node.setdefault(p, {})
+            node[parts[-1]] = val
+        return out
+
+    def render_prometheus(self) -> str:
+        """Prometheus/OpenMetrics-style text exposition. Dotted names
+        flatten to underscores under the ``sbeacon_`` namespace."""
+        lines: list[str] = []
+        for inst in self._snapshot():
+            val = inst.collect()
+            if val is None:
+                continue
+            pname = "sbeacon_" + inst.name.replace(".", "_")
+            if inst.help:
+                lines.append(f"# HELP {pname} {inst.help}")
+            lines.append(f"# TYPE {pname} {inst.kind}")
+            if inst.kind == "histogram":
+                label = inst.label
+                for key, series in sorted(val.items()):
+                    base = f'{label}="{_esc(key)}",' if label and key else ""
+                    for le, cum in series["buckets"].items():
+                        lines.append(
+                            f'{pname}_bucket{{{base}le="{le}"}} {cum}'
+                        )
+                    sfx = f"{{{base[:-1]}}}" if base else ""
+                    lines.append(f"{pname}_sum{sfx} {series['sum']}")
+                    lines.append(f"{pname}_count{sfx} {series['count']}")
+            elif isinstance(val, dict):
+                label = inst.label or "key"
+                for key, v in sorted(val.items()):
+                    lines.append(
+                        f'{pname}{{{label}="{_esc(str(key))}"}} {_num(v)}'
+                    )
+            else:
+                lines.append(f"{pname} {_num(val)}")
+        return "\n".join(lines) + "\n"
+
+
+def _esc(s: str) -> str:
+    return s.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _num(v) -> str:
+    try:
+        f = float(v)
+    except (TypeError, ValueError):
+        return "0"
+    return f"{f:g}"
+
+
+# -- request context / distributed tracing ------------------------------------
+
+#: the cross-process trace header (coordinator->worker and client->API)
+TRACE_HEADER = "X-Beacon-Trace"
+
+
+def new_trace_id() -> str:
+    """64-bit hex trace id (the Dapper convention's width)."""
+    return uuid.uuid4().hex[:16]
+
+
+#: acceptable inbound trace ids — anything else is replaced with a
+#: fresh id, since the value is re-emitted into outbound worker HTTP
+#: headers and log lines (no CRLF or unbounded junk pass-through)
+_TRACE_ID_RE = re.compile(r"^[A-Za-z0-9_.\-]{1,64}$")
+
+
+def sanitize_trace_id(raw: str | None) -> str | None:
+    """``raw`` if it is a well-formed trace id, else None."""
+    if raw and _TRACE_ID_RE.match(raw):
+        return raw
+    return None
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+class RequestContext:
+    """Ambient per-request identity: one trace id from ingress to every
+    worker hop, plus an outcome-notes dict producers annotate (cache
+    hit/miss, fused/mesh path, breaker trips) that the slow-query log
+    snapshots. ``notes`` is copy-on-write (:func:`annotate` rebinds a
+    fresh dict, never mutates in place), so a reader iterating its
+    snapshot can never race a writer — an abandoned pool thread may
+    still be annotating after the request returned. Two concurrent
+    annotates may drop one note; acceptable for observability."""
+
+    __slots__ = ("trace_id", "route", "t_start", "notes")
+
+    def __init__(self, trace_id: str | None = None, route: str = ""):
+        self.trace_id = trace_id or new_trace_id()
+        self.route = route
+        self.t_start = time.perf_counter()
+        self.notes: dict = {}
+
+    def elapsed_ms(self) -> float:
+        return (time.perf_counter() - self.t_start) * 1e3
+
+
+_ambient = threading.local()
+
+
+def current_context() -> RequestContext | None:
+    """The request context the API layer scoped onto this thread (or
+    None). Pool workers re-install the submitting request's context via
+    :func:`request_context`, exactly like ambient deadlines."""
+    return getattr(_ambient, "ctx", None)
+
+
+@contextmanager
+def request_context(ctx: RequestContext | None):
+    """Install ``ctx`` as this thread's ambient request context
+    (``None`` restores 'no context' — safe to pass through)."""
+    prev = getattr(_ambient, "ctx", None)
+    _ambient.ctx = ctx
+    try:
+        yield ctx
+    finally:
+        _ambient.ctx = prev
+
+
+def annotate(**kw) -> None:
+    """Attach outcome notes (``response_cache="hit"``, ``path="fused"``)
+    to the current request, if any — a no-op off-request, so producers
+    call it unconditionally. Copy-on-write rebind: the previous notes
+    dict is never mutated, so concurrent readers (the slow-query log
+    snapshotting a request an abandoned pool thread still annotates)
+    cannot crash mid-iteration."""
+    ctx = getattr(_ambient, "ctx", None)
+    if ctx is not None:
+        ctx.notes = {**ctx.notes, **kw}
+
+
+# -- slow-query log -----------------------------------------------------------
+
+
+class SlowQueryLog:
+    """Structured slow-request record: any request whose latency tops
+    ``threshold_ms`` emits one JSON line (trace id, route, status,
+    elapsed, outcome notes) to the ``sbeacon.slowquery`` logger (and an
+    optional file) and lands in a bounded in-memory ring for ``/_trace``
+    adjacency. ``threshold_ms < 0`` disables; ``0`` records everything
+    (debug). The fast path for a request under threshold is one float
+    compare."""
+
+    def __init__(self, threshold_ms: float = 1000.0, *,
+                 keep: int = 256, path: str = ""):
+        self.threshold_ms = float(threshold_ms)
+        self.path = path
+        self._keep = max(1, keep)
+        self._lock = threading.Lock()
+        self._ring: list[dict] = []
+        self._count = 0
+        self._logger = logging.getLogger("sbeacon.slowquery")
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def recent(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def maybe_record(self, *, trace_id: str, route: str, status: int,
+                     elapsed_ms: float, notes: dict | None = None) -> bool:
+        if self.threshold_ms < 0 or elapsed_ms < self.threshold_ms:
+            return False
+        entry = {
+            "traceId": trace_id,
+            "route": route,
+            "status": int(status),
+            "elapsedMs": round(elapsed_ms, 2),
+            "thresholdMs": self.threshold_ms,
+            "time": time.time(),
+        }
+        if notes:
+            entry["notes"] = dict(notes)
+        line = json.dumps(entry, sort_keys=True, default=str)
+        with self._lock:
+            self._count += 1
+            self._ring.append(entry)
+            if len(self._ring) > self._keep:
+                del self._ring[: -self._keep]
+        self._logger.warning("%s", line)
+        if self.path:
+            try:
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
+            except OSError:  # a full disk must not fail the request
+                log.exception("slow-query log write failed")
+        return True
+
+
+# -- profiling hooks ----------------------------------------------------------
+
+
+class _Profiler:
+    """``SBEACON_PROFILE=<dir>`` arms jax.profiler capture: the first
+    :func:`profile_region` entry starts one process-wide trace into the
+    directory (stopped at exit), and every region runs under a named
+    ``TraceAnnotation`` so kernel launch/fetch show up as labeled spans
+    in the profile. Unarmed (the default), a region entry is one
+    attribute check — the hot path pays nothing."""
+
+    def __init__(self, directory: str | None = None):
+        if directory is None:
+            directory = os.environ.get("SBEACON_PROFILE", "")
+        self.directory = directory
+        self._lock = threading.Lock()
+        self._started = False
+        self._failed = False
+
+    def _ensure_started(self) -> bool:
+        with self._lock:
+            if self._started:
+                return True
+            if self._failed:
+                return False
+            try:
+                import atexit
+
+                import jax
+
+                os.makedirs(self.directory, exist_ok=True)
+                jax.profiler.start_trace(self.directory)
+                atexit.register(self._stop)
+                self._started = True
+                return True
+            except Exception:
+                # profiling is an optimisation aid, never a dependency
+                log.exception("jax profiler unavailable; disabling")
+                self._failed = True
+                return False
+
+    def _stop(self) -> None:
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:
+            pass
+
+    @contextmanager
+    def region(self, name: str):
+        if not self.directory or not self._ensure_started():
+            yield
+            return
+        try:
+            import jax
+
+            ann = jax.profiler.TraceAnnotation(name)
+        except Exception:
+            yield
+            return
+        with ann:
+            yield
+
+
+profiler = _Profiler()
+
+
+def profile_region(name: str):
+    """``with profile_region("kernel.launch"): ...`` — no-op unless
+    ``SBEACON_PROFILE`` is set."""
+    return profiler.region(name)

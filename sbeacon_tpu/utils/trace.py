@@ -30,16 +30,28 @@ import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 
+from ..telemetry import current_context, new_span_id
+
 
 @dataclass(eq=False)  # identity equality: `in`-checks on the span stack
 class Span:
-    """One finished timed region. ``children`` preserves call structure."""
+    """One finished timed region. ``children`` preserves call structure.
+
+    ``trace_id`` ties the span to the distributed request identity the
+    telemetry plane carries (telemetry.RequestContext): every span
+    opened while a request context is ambient — including on a worker
+    host that received the id via the ``X-Beacon-Trace`` header —
+    shares that request's trace id, so one fan-out query's spans
+    correlate across processes. ``span_id`` names this span itself.
+    """
 
     name: str
     t_start: float
     t_end: float = 0.0
     meta: dict = field(default_factory=dict)
     children: list["Span"] = field(default_factory=list)
+    trace_id: str = ""
+    span_id: str = ""
 
     @property
     def elapsed(self) -> float:
@@ -49,6 +61,17 @@ class Span:
         yield self
         for c in self.children:
             yield from c.flatten()
+
+    def to_dict(self) -> dict:
+        """JSON-ready form for the /_trace debug endpoint."""
+        return {
+            "name": self.name,
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "elapsedMs": round(1e3 * self.elapsed, 3),
+            "meta": dict(self.meta),
+            "children": [c.to_dict() for c in self.children],
+        }
 
 
 class _NullSpan:
@@ -131,6 +154,10 @@ class Tracer:
         if not self.is_enabled:
             return _NULL
         sp = Span(name=name, t_start=time.perf_counter(), meta=dict(meta))
+        ctx = current_context()
+        if ctx is not None:
+            sp.trace_id = ctx.trace_id
+        sp.span_id = new_span_id()
         stack = getattr(self._local, "stack", None)
         if stack is None:
             stack = self._local.stack = []
@@ -139,7 +166,11 @@ class Tracer:
 
     def _finish(self, sp: Span) -> None:
         sp.t_end = time.perf_counter()
-        stack = self._local.stack
+        # a span entered on one thread may be exited on another (the
+        # batcher's launcher/fetcher pools hand work across threads):
+        # the finishing thread then has no span stack at all — record
+        # stats only instead of raising AttributeError mid-request
+        stack = getattr(self._local, "stack", None) or ()
         was_root = False
         if sp in stack:
             # spans still open above sp were opened inside its scope: a
@@ -148,6 +179,13 @@ class Tracer:
             while stack[-1] is not sp:
                 sp.children.append(stack.pop())
             stack.pop()
+            # spans beneath that already finished were exited on
+            # ANOTHER thread (stats-only, never popped here): they can
+            # never be popped by their own exit, so left in place they
+            # would adopt every later tree on this thread and grow
+            # unboundedly — drop them; their stats are already recorded
+            while stack and stack[-1].t_end:
+                stack.pop()
             if stack:
                 stack[-1].children.append(sp)
             else:
@@ -190,6 +228,17 @@ class Tracer:
         with self._lock:
             self.stats.clear()
             self.trees.clear()
+
+    def recent_trees(self, trace_id: str | None = None) -> list[dict]:
+        """The retained complete span trees as JSON-ready dicts (the
+        /_trace payload), newest last; ``trace_id`` filters to one
+        distributed request's spans."""
+        with self._lock:
+            trees = list(self.trees)
+        out = [t.to_dict() for t in trees]
+        if trace_id is not None:
+            out = [t for t in out if t["traceId"] == trace_id]
+        return out
 
     def report(self) -> str:
         """Aggregate table + the most recent span tree."""

@@ -1,0 +1,397 @@
+"""Telemetry plane: typed registry, renderings, schema stability,
+request context, slow-query log, and the metric-name lint (ISSUE 4)."""
+
+import json
+import re
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from sbeacon_tpu.telemetry import (
+    LATENCY_BUCKETS_MS,
+    MetricsRegistry,
+    RequestContext,
+    SlowQueryLog,
+    annotate,
+    current_context,
+    new_trace_id,
+    request_context,
+)
+
+obs = pytest.mark.obs
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# -- registry unit ------------------------------------------------------------
+
+
+@obs
+def test_counter_gauge_histogram_roundtrip():
+    reg = MetricsRegistry()
+    c = reg.counter("t.hits")
+    g = reg.gauge("t.depth")
+    h = reg.histogram("t.lat_ms")
+    c.inc()
+    c.inc(2)
+    g.set(7)
+    h.observe(3.0)
+    h.observe(9999.0)
+    h.observe(1e9)  # overflow bucket
+    j = reg.render_json()
+    assert j["t"]["hits"] == 3
+    assert j["t"]["depth"] == 7
+    hist = j["t"]["lat_ms"]
+    assert hist["count"] == 3
+    assert hist["buckets"]["+Inf"] == 3
+    # cumulative: everything <= 10000 bucket except the 1e9 outlier
+    assert hist["buckets"]["10000"] == 2
+
+
+@obs
+def test_registry_rejects_duplicates_and_bad_names():
+    reg = MetricsRegistry()
+    reg.counter("a.b")
+    with pytest.raises(ValueError):
+        reg.counter("a.b")
+    with pytest.raises(ValueError):
+        reg.counter("nodots")
+    with pytest.raises(ValueError):
+        reg.gauge("Upper.Case")
+
+
+@obs
+def test_labeled_series_and_callback_instruments():
+    reg = MetricsRegistry()
+    c = reg.counter("t.by_route", label="route")
+    c.inc(label_value="a")
+    c.inc(2, label_value="b")
+    reg.gauge("t.live", fn=lambda: 42)
+    j = reg.render_json()
+    assert j["t"]["by_route"] == {"a": 1, "b": 2}
+    assert j["t"]["live"] == 42
+    text = reg.render_prometheus()
+    assert 'sbeacon_t_by_route{route="a"} 1' in text
+    assert "sbeacon_t_live 42" in text
+
+
+@obs
+def test_broken_callback_does_not_kill_render():
+    reg = MetricsRegistry()
+    reg.gauge("t.bad", fn=lambda: 1 / 0)
+    reg.gauge("t.good", fn=lambda: 1)
+    assert reg.render_json()["t"]["good"] == 1
+    assert "sbeacon_t_good 1" in reg.render_prometheus()
+
+
+_SAMPLE = re.compile(
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[^{}]*\})? -?\d+(\.\d+)?([eE][+-]?\d+)?$"
+)
+
+
+def _assert_valid_exposition(text: str) -> dict:
+    """Minimal Prometheus text-format parser: every non-comment line is
+    ``name{labels} value``; returns {metric_name: n_samples}."""
+    seen: dict = {}
+    for line in text.strip().splitlines():
+        if not line or line.startswith("#"):
+            continue
+        assert _SAMPLE.match(line), f"invalid exposition line: {line!r}"
+        name = line.split("{")[0].split(" ")[0]
+        seen[name] = seen.get(name, 0) + 1
+    return seen
+
+
+@obs
+def test_prometheus_rendering_parses_with_histograms():
+    reg = MetricsRegistry()
+    h = reg.histogram("req.lat_ms", label="route")
+    h.observe(3.0, label_value="g_variants")
+    h.observe(700.0, label_value="g_variants")
+    h.observe(1.0, label_value="info")
+    seen = _assert_valid_exposition(reg.render_prometheus())
+    # one bucket series per boundary (+Inf) per route, plus sum/count
+    assert seen["sbeacon_req_lat_ms_bucket"] == 2 * (
+        len(LATENCY_BUCKETS_MS) + 1
+    )
+    assert seen["sbeacon_req_lat_ms_sum"] == 2
+    assert seen["sbeacon_req_lat_ms_count"] == 2
+
+
+# -- /metrics schema stability (golden keys) ----------------------------------
+
+#: the documented metric catalogue (DEPLOYMENT.md "Observability"):
+#: renaming any of these must break CI here, not dashboards
+GOLDEN_METRICS = [
+    "request.latency_ms",
+    "request.slow_queries",
+    "admission.max_in_flight",
+    "admission.in_flight",
+    "admission.admitted",
+    "admission.shed",
+    "runner.workers",
+    "runner.max_pending",
+    "runner.active",
+    "runner.shed",
+    "batcher.submits",
+    "batcher.specs",
+    "batcher.launches",
+    "batcher.mean_batch",
+    "batcher.expired",
+    "batcher.timeouts",
+    "batcher.histogram",
+    "batcher.fused_hist",
+    "batcher.launcher.threads",
+    "batcher.launcher.queued",
+    "batcher.fetcher.threads",
+    "batcher.fetcher.queued",
+    "batcher.queue_wait_ms",
+    "batcher.exec_ms",
+    "batcher.encode_ms",
+    "batcher.launch_ms",
+    "batcher.fetch_ms",
+    "engine.fused_searches",
+    "engine.mesh_searches",
+    "engine.materialize_ms",
+    "response_cache.entries",
+    "response_cache.max_entries",
+    "response_cache.ttl_s",
+    "response_cache.hits",
+    "response_cache.misses",
+    "response_cache.hit_rate",
+    "response_cache.negative_hits",
+    "response_cache.evictions",
+    "response_cache.expirations",
+    "response_cache.invalidations",
+    "breaker.state",
+    "breaker.consecutive_failures",
+    "breaker.opens",
+]
+
+
+@pytest.fixture()
+def app():
+    from sbeacon_tpu.api import BeaconApp
+
+    app = BeaconApp()
+    try:
+        yield app
+    finally:
+        app.close()
+
+
+@obs
+def test_metrics_golden_keys_registered(app):
+    missing = [n for n in GOLDEN_METRICS if n not in app.telemetry.names()]
+    assert not missing, f"documented metrics missing: {missing}"
+
+
+@obs
+def test_metrics_json_rendering_keeps_golden_paths(app):
+    status, body = app.handle("GET", "/metrics")
+    assert status == 200
+    # breaker renders in its historical per-route JSON shape (or not at
+    # all on single-host engines), so it is Prometheus-only here
+    for name in GOLDEN_METRICS:
+        if name.startswith("breaker."):
+            continue
+        node = body
+        for part in name.split("."):
+            assert isinstance(node, dict) and part in node, (
+                f"/metrics JSON lost {name} at {part!r}"
+            )
+            node = node[part]
+
+
+@obs
+def test_metrics_prometheus_rendering_keeps_golden_names(app):
+    status, text = app.handle("GET", "/metrics", {"format": "prometheus"})
+    assert status == 200 and isinstance(text, str)
+    _assert_valid_exposition(text)
+    for name in GOLDEN_METRICS:
+        pname = "sbeacon_" + name.replace(".", "_")
+        assert f"# TYPE {pname} " in text, f"exposition lost {pname}"
+
+
+@obs
+def test_metrics_prometheus_via_accept_header(app):
+    status, text = app.handle(
+        "GET", "/metrics", None, None, {"Accept": "text/plain"}
+    )
+    assert status == 200 and isinstance(text, str)
+    assert "sbeacon_admission_in_flight" in text
+
+
+@obs
+def test_request_latency_histogram_per_route(app):
+    app.handle("GET", "/info")
+    app.handle("GET", "/map")
+    app.handle("GET", "/does-not-exist")
+    _, body = app.handle("GET", "/metrics")
+    lat = body["request"]["latency_ms"]
+    assert "info" in lat and "map" in lat and "other" in lat
+    assert lat["info"]["count"] >= 1
+    _, text = app.handle("GET", "/metrics", {"format": "prometheus"})
+    assert 'sbeacon_request_latency_ms_bucket{route="info",le="+Inf"}' in text
+
+
+@obs
+def test_malformed_inbound_trace_id_is_replaced(app):
+    # the inbound value is re-emitted into outbound worker headers and
+    # log lines: junk (oversized, control chars) must not pass through
+    for bad in ("x" * 200, "evil\r\nInjected: 1", ""):
+        _, body = app.handle(
+            "GET", "/info", None, None, {"X-Beacon-Trace": bad}
+        )
+        tid = body["meta"]["traceId"]
+        assert tid != bad and re.fullmatch(r"[0-9a-f]{16}", tid)
+
+
+@obs
+def test_trace_id_minted_and_honored_in_envelope(app):
+    _, body = app.handle("GET", "/info")
+    tid = body["meta"]["traceId"]
+    assert re.fullmatch(r"[0-9a-f]{16}", tid)
+    assert body["meta"]["elapsedTimeMs"] >= 0
+    want = new_trace_id()
+    _, body = app.handle(
+        "GET", "/info", None, None, {"X-Beacon-Trace": want}
+    )
+    assert body["meta"]["traceId"] == want
+
+
+# -- request context ----------------------------------------------------------
+
+
+@obs
+def test_request_context_scoping_and_annotate():
+    assert current_context() is None
+    annotate(ignored=True)  # no ambient context: must be a no-op
+    ctx = RequestContext(route="g_variants")
+    with request_context(ctx):
+        assert current_context() is ctx
+        annotate(response_cache="hit")
+        inner = RequestContext()
+        with request_context(inner):
+            assert current_context() is inner
+        assert current_context() is ctx
+    assert current_context() is None
+    assert ctx.notes == {"response_cache": "hit"}
+
+
+@obs
+def test_request_context_is_thread_local():
+    ctx = RequestContext()
+    seen = {}
+
+    def other():
+        seen["ctx"] = current_context()
+
+    with request_context(ctx):
+        t = threading.Thread(target=other)
+        t.start()
+        t.join()
+    assert seen["ctx"] is None
+
+
+# -- slow-query log -----------------------------------------------------------
+
+
+@obs
+def test_slow_query_log_threshold_and_ring(tmp_path):
+    path = tmp_path / "slow.jsonl"
+    slog = SlowQueryLog(threshold_ms=5.0, keep=2, path=str(path))
+    assert not slog.maybe_record(
+        trace_id="t1", route="info", status=200, elapsed_ms=1.0
+    )
+    for k in range(3):
+        assert slog.maybe_record(
+            trace_id=f"t{k}",
+            route="g_variants",
+            status=200,
+            elapsed_ms=10.0 + k,
+            notes={"response_cache": "miss"},
+        )
+    assert slog.count() == 3
+    recent = slog.recent()
+    assert len(recent) == 2  # ring bounded by keep
+    assert recent[-1]["traceId"] == "t2"
+    assert recent[-1]["notes"] == {"response_cache": "miss"}
+    lines = [json.loads(x) for x in path.read_text().splitlines()]
+    assert [e["traceId"] for e in lines] == ["t0", "t1", "t2"]
+
+
+@obs
+def test_slow_query_log_disabled_and_log_everything():
+    off = SlowQueryLog(threshold_ms=-1.0)
+    assert not off.maybe_record(
+        trace_id="t", route="r", status=200, elapsed_ms=1e9
+    )
+    everything = SlowQueryLog(threshold_ms=0.0)
+    assert everything.maybe_record(
+        trace_id="t", route="r", status=200, elapsed_ms=0.01
+    )
+
+
+@obs
+def test_slow_query_fires_through_the_api(tmp_path):
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        ObservabilityConfig,
+        StorageConfig,
+    )
+
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "store"),
+        observability=ObservabilityConfig(slow_query_ms=0.0),
+    )
+    cfg.storage.ensure()
+    app = BeaconApp(cfg)
+    try:
+        _, body = app.handle("GET", "/info")
+        tid = body["meta"]["traceId"]
+        entries = app.slow_log.recent()
+        assert entries and entries[-1]["traceId"] == tid
+        assert entries[-1]["route"] == "info"
+        _, m = app.handle("GET", "/metrics")
+        assert m["request"]["slow_queries"] >= 1
+    finally:
+        app.close()
+
+
+# -- metric-name lint (CI wiring for tools/check_metric_names.py) -------------
+
+
+@obs
+def test_metric_name_lint():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_metric_names.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@obs
+def test_metric_name_lint_catches_violations():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_metric_names import lint
+    finally:
+        sys.path.pop(0)
+
+    errors = lint(
+        [
+            ("a.b", "counter", "x.py:1", False),
+            ("a.b", "gauge", "y.py:2", False),  # duplicate
+            ("nodots", "counter", "z.py:3", False),  # bad grammar
+            ("c.d", "counter", "w.py:4", True),  # f-string
+        ]
+    )
+    assert len(errors) == 3

@@ -116,3 +116,49 @@ def test_keep_trees_bounded():
         with t.span("r"):
             pass
     assert len(t.trees) == 3
+
+
+def test_cross_thread_finish_records_stats_only():
+    """A span entered on one thread and exited on another (exactly what
+    the launcher/fetcher pools do) must record stats, not raise
+    AttributeError on the finishing thread's absent span stack."""
+    t = Tracer(enabled=True)
+    active = t.span("xthread")
+    errors = []
+
+    def finisher():
+        try:
+            active.__exit__(None, None, None)
+        except Exception as e:  # noqa: BLE001 - the regression under test
+            errors.append(e)
+
+    th = threading.Thread(target=finisher)
+    th.start()
+    th.join()
+    assert not errors, errors
+    assert t.stats["xthread"][0] == 1
+    # the opening thread's stack still holds the orphan: a later span
+    # on this thread must not crash, AND must still produce a root tree
+    # (the orphan must not adopt every future tree on this thread)
+    with t.span("after"):
+        pass
+    assert t.stats["after"][0] == 1
+    assert [tree.name for tree in t.trees] == ["after"]
+
+
+def test_spans_carry_ambient_trace_id():
+    from sbeacon_tpu.telemetry import RequestContext, request_context
+
+    t = Tracer(enabled=True)
+    ctx = RequestContext(trace_id="feedfacefeedface")
+    with request_context(ctx):
+        with t.span("traced"):
+            pass
+    with t.span("untraced"):
+        pass
+    traced, untraced = t.trees
+    assert traced.trace_id == "feedfacefeedface"
+    assert traced.span_id and len(traced.span_id) == 16
+    assert untraced.trace_id == ""
+    # structured serialization for /_trace
+    assert t.recent_trees(trace_id="feedfacefeedface") == [traced.to_dict()]

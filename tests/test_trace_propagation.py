@@ -1,0 +1,220 @@
+"""Distributed trace propagation (ISSUE 4 acceptance): one trace id
+from API ingress through the coordinator's runner pool, across the
+coordinator->worker HTTP boundary via ``X-Beacon-Trace``, into
+worker-side spans, and back out in the response envelope, the /_trace
+debug surface, and the slow-query log."""
+
+import random
+
+import pytest
+
+from sbeacon_tpu.config import (
+    BeaconConfig,
+    EngineConfig,
+    ObservabilityConfig,
+    StorageConfig,
+)
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+from sbeacon_tpu.telemetry import TRACE_HEADER, new_trace_id
+from sbeacon_tpu.testing import random_records
+from sbeacon_tpu.utils.trace import tracer
+
+obs = pytest.mark.obs
+
+
+def _worker_engine(*dataset_ids, seed0):
+    eng = VariantEngine(BeaconConfig(engine=EngineConfig(microbatch=False)))
+    for k, ds in enumerate(dataset_ids):
+        rng = random.Random(seed0 + k)
+        recs = random_records(rng, chrom="1", n=120, n_samples=2)
+        eng.add_index(
+            build_index(
+                recs,
+                dataset_id=ds,
+                vcf_location=f"{ds}.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+    return eng
+
+
+@pytest.fixture()
+def fanout_app(tmp_path):
+    """Coordinator BeaconApp over two real worker HTTP servers, tracing
+    enabled for the duration, slow-query log recording everything."""
+    from sbeacon_tpu.api import BeaconApp
+
+    w1 = WorkerServer(_worker_engine("dsA", seed0=100)).start_background()
+    w2 = WorkerServer(_worker_engine("dsB", seed0=200)).start_background()
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "store"),
+        engine=EngineConfig(microbatch=False),
+        observability=ObservabilityConfig(slow_query_ms=0.0),
+    )
+    cfg.storage.ensure()
+    dist = DistributedEngine(
+        [w1.address, w2.address], local=VariantEngine(cfg), config=cfg
+    )
+    app = BeaconApp(cfg, engine=dist)
+    for ds in ("dsA", "dsB"):
+        app.store.upsert(
+            "datasets",
+            [
+                {
+                    "id": ds,
+                    "name": ds,
+                    "_assemblyId": "GRCh38",
+                    "_vcfLocations": [f"{ds}.vcf.gz"],
+                }
+            ],
+        )
+    tracer.enable()
+    tracer.reset()
+    try:
+        yield app
+    finally:
+        tracer.disable()
+        tracer.reset()
+        app.close()
+        dist.close()
+        w1.shutdown()
+        w2.shutdown()
+
+
+def _query_body():
+    return {
+        "query": {
+            "requestedGranularity": "boolean",
+            "requestParameters": {
+                "assemblyId": "GRCh38",
+                "referenceName": "1",
+                "start": [1],
+                "end": [1 << 30],
+                "alternateBases": "N",
+            },
+        }
+    }
+
+
+def _worker_span_trace_ids():
+    ids = set()
+    for tree in tracer.recent_trees():
+        stack = [tree]
+        while stack:
+            node = stack.pop()
+            if node["name"] == "worker.search":
+                ids.add(node["traceId"])
+            stack.extend(node["children"])
+    return ids
+
+
+@obs
+def test_fanout_query_carries_one_trace_id_everywhere(fanout_app):
+    app = fanout_app
+    want = new_trace_id()
+    status, body = app.handle(
+        "POST",
+        "/g_variants",
+        body=_query_body(),
+        headers={TRACE_HEADER: want},
+    )
+    assert status == 200, body
+    assert body["responseSummary"]["exists"] is True
+    # 1) the inbound id round-trips into the response envelope
+    assert body["meta"]["traceId"] == want
+    # 2) worker-side spans (recorded in the worker handler threads,
+    # having crossed a real HTTP boundary) share the same trace id —
+    # proof the X-Beacon-Trace header rode the coordinator->worker call
+    assert want in _worker_span_trace_ids()
+    # 3) /_trace renders the same trace's span trees
+    status, out = app.handle("GET", "/_trace", {"trace_id": want})
+    assert status == 200
+    assert out["traces"], "no span trees for the request's trace id"
+    assert all(t["traceId"] == want for t in out["traces"])
+    # 4) the slow-query log entry carries the id too
+    assert any(e["traceId"] == want for e in app.slow_log.recent())
+
+
+@obs
+def test_legacy_3arg_transport_survives_ambient_context():
+    """A swapped transport with the documented legacy (url, doc,
+    timeout_s) signature must keep working when a request context is
+    ambient — the trace header is dropped, not forced into a TypeError
+    that would trip the breaker."""
+    import dataclasses
+
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.telemetry import RequestContext, request_context
+
+    calls = []
+
+    def post3(url, doc, timeout_s):
+        calls.append(url)
+        return 200, {"responses": []}
+
+    def get3(url, timeout_s):
+        return 200, {"datasets": ["dsX"], "fingerprint": "f"}
+
+    dist = DistributedEngine(
+        ["http://w:1"], retries=0, post=post3, get=get3
+    )
+    try:
+        pay = VariantQueryPayload(
+            dataset_ids=["dsX"],
+            reference_name="1",
+            start_min=1,
+            start_max=1 << 30,
+            end_min=1,
+            end_max=1 << 30,
+            alternate_bases="N",
+        )
+        with request_context(RequestContext()):
+            got = dist.search(dataclasses.replace(pay))
+        assert got == [] and calls == ["http://w:1"]
+        # and a 4-arg transport under the same context DOES get the id
+        seen = {}
+
+        def post4(url, doc, timeout_s, headers=None):
+            seen.update(headers or {})
+            return 200, {"responses": []}
+
+        dist4 = DistributedEngine(
+            ["http://w:1"], retries=0, post=post4, get=get3
+        )
+        try:
+            ctx = RequestContext()
+            with request_context(ctx):
+                dist4.search(dataclasses.replace(pay))
+            assert seen.get(TRACE_HEADER) == ctx.trace_id
+        finally:
+            dist4.close()
+    finally:
+        dist.close()
+
+
+@obs
+def test_fanout_without_inbound_header_mints_one_id(fanout_app):
+    app = fanout_app
+    status, body = app.handle("POST", "/g_variants", body=_query_body())
+    assert status == 200, body
+    tid = body["meta"]["traceId"]
+    assert tid and tid in _worker_span_trace_ids()
+
+
+@obs
+def test_worker_spans_parent_under_one_trace_per_request(fanout_app):
+    """Two sequential requests produce two distinct trace ids, and the
+    worker spans partition accordingly — ids never bleed across
+    requests through the pool hand-offs."""
+    app = fanout_app
+    t1 = app.handle("POST", "/g_variants", body=_query_body())[1]["meta"][
+        "traceId"
+    ]
+    body2 = _query_body()
+    body2["query"]["requestParameters"]["end"] = [(1 << 30) - 1]
+    t2 = app.handle("POST", "/g_variants", body=body2)[1]["meta"]["traceId"]
+    assert t1 != t2
+    seen = _worker_span_trace_ids()
+    assert {t1, t2} <= seen

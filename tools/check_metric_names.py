@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Static metric-name lint for the telemetry plane.
+
+Scans every instrument registration in ``sbeacon_tpu/`` — calls of the
+form ``registry.counter("...")`` / ``reg.gauge("...")`` /
+``registry.histogram("...")`` — and fails when:
+
+- a registration's name is not a string literal (an f-string or a
+  computed name cannot be audited statically, and dynamic names are how
+  dashboards silently lose series),
+- a name does not match the dotted-lowercase grammar the registry
+  enforces at runtime (``batcher.launches``),
+- the same name is registered at two different source sites (two
+  producers fighting over one series).
+
+Run directly (``python tools/check_metric_names.py``) or via the tier-1
+test ``tests/test_telemetry.py::test_metric_name_lint``.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+
+#: a registration site: receiver named registry/reg, one of the three
+#: typed constructors, first argument a (possibly f-) string literal
+REGISTRATION = re.compile(
+    r"(?:registry|reg)\s*\.\s*(counter|gauge|histogram)\s*\(\s*(f?)\"([^\"]+)\""
+)
+#: the same grammar telemetry._NAME_RE enforces at runtime
+NAME = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
+
+
+def scan(root: Path = PKG) -> list[tuple[str, str, str, bool]]:
+    """[(name, kind, "file:line", is_fstring)] for every registration."""
+    out = []
+    for path in sorted(root.rglob("*.py")):
+        src = path.read_text()
+        for m in REGISTRATION.finditer(src):
+            kind, fpref, name = m.groups()
+            line = src[: m.start()].count("\n") + 1
+            rel = path.relative_to(root.parent)
+            out.append((name, kind, f"{rel}:{line}", bool(fpref)))
+    return out
+
+
+def lint(registrations) -> list[str]:
+    errors = []
+    seen: dict[str, str] = {}
+    for name, _kind, where, is_fstring in registrations:
+        if is_fstring:
+            errors.append(
+                f"{where}: f-string metric name {name!r} — registration "
+                "names must be plain literals so they can be audited"
+            )
+        if not NAME.match(name):
+            errors.append(
+                f"{where}: invalid metric name {name!r} — want dotted "
+                "lowercase like 'batcher.launches'"
+            )
+        if name in seen:
+            errors.append(
+                f"{where}: duplicate metric name {name!r} "
+                f"(already registered at {seen[name]})"
+            )
+        else:
+            seen[name] = where
+    if not registrations:
+        errors.append(
+            "no instrument registrations found under sbeacon_tpu/ — "
+            "either the telemetry plane was removed or this tool's "
+            "pattern drifted from the registration idiom"
+        )
+    return errors
+
+
+def main() -> int:
+    registrations = scan()
+    errors = lint(registrations)
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}")
+        return 1
+    print(
+        f"ok: {len(registrations)} instrument registrations, "
+        f"{len({r[0] for r in registrations})} unique names"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
