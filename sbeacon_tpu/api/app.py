@@ -292,6 +292,19 @@ class BeaconApp:
             register_breaker_metrics(
                 reg, lambda: getattr(self.engine, "breaker", None)
             )
+        if "transport.conn.opened" not in reg.names():
+            # same catalogue stability for the data-plane transport +
+            # fan-out series: a single-host engine never opens worker
+            # connections, but the instruments exist (zeros) so
+            # dashboards don't flap with the deployment shape
+            from ..parallel.dispatch import register_dispatch_metrics
+            from ..parallel.transport import register_transport_metrics
+
+            register_transport_metrics(reg)
+            register_dispatch_metrics(
+                reg,
+                lambda: getattr(self.engine, "short_circuits", 0),
+            )
 
     #: bounded route-label set for the latency histogram — unknown
     #: paths collapse to "other" so a URL scanner cannot mint series

@@ -238,6 +238,37 @@ class ResilienceConfig:
 
 
 @dataclasses.dataclass(frozen=True)
+class TransportConfig:
+    """Coordinator->worker data-plane knobs (parallel/transport.py).
+
+    The reference's fan-out rode SNS + Lambda invokes, paying per-call
+    setup at the platform tier; here the same costs are explicit TCP
+    handshakes and JSON bytes, and each has a knob:
+
+    pool_size: keep-alive connections kept per worker host. Not a
+      concurrency cap — a scatter burst beyond it opens extra
+      connections that are closed, not pooled, on return.
+    idle_ttl_s: pooled connections idle longer than this are closed on
+      next touch (workers reap their side slightly later).
+    gzip_min_bytes: request bodies at or over this size are
+      gzip-compressed on the wire (0 disables).
+    hedge_delay_s: slice-scan hedging (Dean & Barroso, The Tail at
+      Scale): if a scan's primary worker has not answered within this
+      delay, the same scan is raced on a second worker and the first
+      response wins. >0 = fixed delay; 0 = adaptive (the p95 of recent
+      scan RTTs, once enough samples exist); <0 disables.
+    bool_short_circuit: boolean-granularity fan-outs return as soon as
+      any worker reports a hit, abandoning the rest of the scatter.
+    """
+
+    pool_size: int = 4
+    idle_ttl_s: float = 60.0
+    gzip_min_bytes: int = 32 * 1024
+    hedge_delay_s: float = 0.0
+    bool_short_circuit: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
 class ObservabilityConfig:
     """Telemetry-plane knobs (telemetry.py). Tracing itself stays
     env-gated (``SBEACON_TRACE=1``, utils/trace.py) like the
@@ -292,6 +323,9 @@ class BeaconConfig:
     )
     observability: ObservabilityConfig = dataclasses.field(
         default_factory=ObservabilityConfig
+    )
+    transport: TransportConfig = dataclasses.field(
+        default_factory=TransportConfig
     )
 
     @staticmethod
@@ -392,6 +426,21 @@ class BeaconConfig:
             if var in env:
                 res_over[field] = conv(env[var])
         resilience = ResilienceConfig(**res_over)
+        tr_over: dict = {}
+        _tr_env = {
+            "BEACON_POOL_SIZE": ("pool_size", int),
+            "BEACON_POOL_IDLE_S": ("idle_ttl_s", float),
+            "BEACON_GZIP_MIN_BYTES": ("gzip_min_bytes", int),
+            "BEACON_HEDGE_DELAY_S": ("hedge_delay_s", float),
+        }
+        for var, (field, conv) in _tr_env.items():
+            if var in env:
+                tr_over[field] = conv(env[var])
+        if "BEACON_BOOL_SHORT_CIRCUIT" in env:
+            tr_over["bool_short_circuit"] = (
+                env["BEACON_BOOL_SHORT_CIRCUIT"].lower() not in _off
+            )
+        transport = TransportConfig(**tr_over)
         obs_over: dict = {}
         if "SBEACON_SLOW_QUERY_MS" in env:
             obs_over["slow_query_ms"] = float(env["SBEACON_SLOW_QUERY_MS"])
@@ -409,6 +458,7 @@ class BeaconConfig:
             auth=auth,
             resilience=resilience,
             observability=observability,
+            transport=transport,
         )
 
     def dumps(self) -> str:

@@ -162,11 +162,14 @@ class SummarisationPipeline:
         if scan_pool is None and self.config.ingest.scan_worker_urls:
             from ..parallel.dispatch import ScanWorkerPool
 
+            tcfg = self.config.transport
             scan_pool = ScanWorkerPool(
                 list(self.config.ingest.scan_worker_urls),
                 token=self.config.auth.worker_token,
                 timeout_s=self.config.ingest.scan_timeout_s,
                 retries=self.config.ingest.scan_retries,
+                hedge_delay_s=tcfg.hedge_delay_s,
+                transport_config=tcfg,
             )
         self.scan_pool = scan_pool
 

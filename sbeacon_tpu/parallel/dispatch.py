@@ -15,26 +15,38 @@ reference's 10x save / retry loops), and merges the per-(dataset,vcf)
 response lists — presenting the exact ``VariantEngine`` interface so the
 API layer, job table, and micro-batcher compose unchanged.
 
-Transport is stdlib HTTP+JSON (the payload types' stable dict form);
-swap ``urllib_post`` for gRPC/DCN transport in a pod deployment. For
+Transport is stdlib HTTP+JSON (the payload types' stable dict form)
+over the pooled keep-alive layer in ``transport.py`` (per-worker
+connection pools, hedged scans, gzip bodies); inject ``post=``/``get=``
+callables to swap in gRPC/DCN transport in a pod deployment. For
 multi-host *compute* (one jit program spanning hosts), see
 ``init_multihost`` — jax.distributed over the same coordinator model.
 """
 
 from __future__ import annotations
 
+import collections
+import dataclasses
+import gzip
 import hmac
 import json
 import logging
 import threading
 import time
 import urllib.error
-import urllib.request
 import concurrent.futures as futures_mod
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..harness.faults import fault_point
+from .transport import (
+    PooledTransport,
+    note_hedge,
+    register_transport_metrics,
+    urllib_get,
+    urllib_post,
+    urllib_post_bytes,
+)
 from ..payloads import (
     SliceScanPayload,
     VariantQueryPayload,
@@ -67,8 +79,25 @@ def _make_handler(
     engine, token: str = "", open_scan: bool = False, reload_fn=None
 ):
     class Handler(BaseHTTPRequestHandler):
+        # keep-alive: the coordinator's pooled transport holds a few
+        # persistent connections per worker instead of a TCP handshake
+        # (and a ThreadingHTTPServer thread spawn) per call
+        protocol_version = "HTTP/1.1"
+        # reap idle keep-alive connections a little after the
+        # coordinator's pool TTL would have evicted them anyway
+        timeout = 120.0
+
         def log_message(self, *a):  # quiet
             pass
+
+        def _read_body(self) -> bytes:
+            """The full request body, gunzipped when the coordinator
+            compressed it (transport.py gzip_min_bytes)."""
+            n = int(self.headers.get("Content-Length") or 0)
+            raw = self.rfile.read(n) if n else b""
+            if self.headers.get("Content-Encoding", "").lower() == "gzip":
+                raw = gzip.decompress(raw)
+            return raw
 
         def _send(self, status: int, payload):
             body = json.dumps(payload).encode()
@@ -115,6 +144,14 @@ def _make_handler(
             self.wfile.write(body)
 
         def do_POST(self):
+            # the body is read BEFORE any early return: with HTTP/1.1
+            # keep-alive, unread body bytes would bleed into the next
+            # request's parse on this connection
+            try:
+                raw = self._read_body()
+            except Exception:
+                self._send(400, {"error": "bad request body"})
+                return
             if not self._authorized():
                 self._send(401, {"error": "unauthorized"})
                 return
@@ -147,16 +184,13 @@ def _make_handler(
                         },
                     )
                     return
-                self._do_scan()
+                self._do_scan(raw)
                 return
             if self.path != "/search":
                 self._send(404, {"error": "not found"})
                 return
             try:
-                n = int(self.headers.get("Content-Length", 0))
-                payload = VariantQueryPayload(
-                    **json.loads(self.rfile.read(n))
-                )
+                payload = VariantQueryPayload(**json.loads(raw))
                 # adopt the coordinator's trace id (X-Beacon-Trace) so
                 # worker-side spans parent into the same distributed
                 # trace; a direct caller without the header gets a
@@ -174,13 +208,17 @@ def _make_handler(
                     responses = engine.search(payload)
                 self._send(
                     200,
-                    {"responses": [json.loads(r.dumps()) for r in responses]},
+                    {
+                        "responses": [
+                            dataclasses.asdict(r) for r in responses
+                        ]
+                    },
                 )
             except Exception as e:  # worker errors travel to coordinator
                 log.exception("worker search failed")
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
-        def _do_scan(self):
+        def _do_scan(self, raw: bytes):
             """Ingest slice-scan leaf (the summariseSlice worker role):
             range-read + parse + build one slice shard, returned as a raw
             npz blob. The VCF location must be reachable from the worker
@@ -189,8 +227,7 @@ def _make_handler(
                 from ..index.columnar import dumps_index
                 from ..ingest.pipeline import scan_slice_to_shard
 
-                n = int(self.headers.get("Content-Length", 0))
-                p = SliceScanPayload(**json.loads(self.rfile.read(n)))
+                p = SliceScanPayload(**json.loads(raw))
                 shard = scan_slice_to_shard(
                     p.vcf_location,
                     p.vstart,
@@ -245,50 +282,23 @@ class WorkerServer:
 
 
 # -- coordinator side ---------------------------------------------------------
+#
+# urllib_post / urllib_get / urllib_post_bytes live in transport.py now
+# (re-exported above for back-compat): every real coordinator->worker
+# call goes through the pooled keep-alive transport, and the unpooled
+# fallbacks are kept only as injectable seams and CLI probes.
 
 
-def urllib_post(
-    url: str, doc: dict, timeout_s: float, headers: dict | None = None
-) -> tuple[int, dict]:
-    req = urllib.request.Request(
-        url,
-        data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json", **(headers or {})},
-        method="POST",
+def register_dispatch_metrics(registry, supplier) -> None:
+    """The coordinator fan-out's own series. ``supplier`` returns the
+    current short-circuit count (0 on single-host engines — the app's
+    fallback registration keeps the catalogue deployment-stable, like
+    the breaker series)."""
+    registry.counter(
+        "dispatch.short_circuits",
+        "boolean fan-outs answered before the full worker drain",
+        fn=supplier,
     )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return resp.status, json.loads(resp.read())
-    except urllib.error.HTTPError as e:
-        try:
-            return e.code, json.loads(e.read())
-        except Exception:
-            return e.code, {"error": str(e)}
-
-
-def urllib_get(
-    url: str, timeout_s: float, headers: dict | None = None
-) -> tuple[int, dict]:
-    req = urllib.request.Request(url, headers=headers or {})
-    with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-        return resp.status, json.loads(resp.read())
-
-
-def urllib_post_bytes(
-    url: str, doc: dict, timeout_s: float, headers: dict | None = None
-) -> tuple[int, bytes]:
-    """JSON request -> raw-bytes response (the slice-scan transport)."""
-    req = urllib.request.Request(
-        url,
-        data=json.dumps(doc).encode(),
-        headers={"Content-Type": "application/json", **(headers or {})},
-        method="POST",
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
-            return resp.status, resp.read()
-    except urllib.error.HTTPError as e:
-        return e.code, e.read()
 
 
 class ScanWorkerPool:
@@ -303,7 +313,22 @@ class ScanWorkerPool:
     open for ``cooldown_s``, then a half-open probe) so one wedged host
     cannot stall every slice for a full timeout each (the dead-worker
     exclusion the query-path scatter already has via discovery refresh).
+
+    Scans are *hedged* (Dean & Barroso, The Tail at Scale): when the
+    primary worker has not answered within the hedge delay — fixed, or
+    adaptive at the p95 of recent scan RTTs — the same slice races on a
+    second worker and the first response wins; the loser is abandoned
+    (slice scans are idempotent reads, so duplicate execution only
+    costs the loser's CPU). One slow host then bounds *its own* calls,
+    not every slice routed to it.
     """
+
+    #: adaptive hedging needs this many completed scans before the p95
+    #: means anything; until then no hedge fires
+    HEDGE_MIN_SAMPLES = 8
+    #: adaptive hedge delay never drops below this (a sub-ms p95 would
+    #: hedge every call and double cluster load for nothing)
+    HEDGE_FLOOR_S = 0.05
 
     def __init__(
         self,
@@ -313,7 +338,10 @@ class ScanWorkerPool:
         timeout_s: float = 120.0,
         retries: int = 1,
         cooldown_s: float = 30.0,
-        post_bytes=urllib_post_bytes,
+        post_bytes=None,
+        hedge_delay_s: float = 0.0,
+        transport: PooledTransport | None = None,
+        transport_config=None,
     ):
         if not worker_urls:
             raise ValueError("ScanWorkerPool needs at least one worker URL")
@@ -322,7 +350,22 @@ class ScanWorkerPool:
         self.timeout_s = timeout_s
         self.retries = retries
         self.cooldown_s = cooldown_s
+        self.hedge_delay_s = hedge_delay_s
+        self._owns_transport = False
+        if post_bytes is None:
+            if transport is None:
+                # built here -> owned here: close() releases the
+                # sockets (a caller-passed transport stays caller-owned)
+                transport = (
+                    PooledTransport.from_config(transport_config)
+                    if transport_config is not None
+                    else PooledTransport()
+                )
+                self._owns_transport = True
+            post_bytes = transport.post_bytes
+        self.transport = transport
         self._post_bytes = post_bytes
+        self._bytes_ok = bool(getattr(post_bytes, "accepts_bytes", False))
         self._next = 0
         # the round-4 ad-hoc _dead_until cooldown map, generalised: a
         # single failure opens the circuit for cooldown_s (scan slices
@@ -332,6 +375,19 @@ class ScanWorkerPool:
             failure_threshold=1, reset_timeout_s=cooldown_s
         )
         self._lock = threading.Lock()
+        self._rtts: collections.deque = collections.deque(maxlen=128)
+        self._hedges = 0
+        self._hedge_wins = 0
+        self._hedge_exec: ThreadPoolExecutor | None = None
+
+    def close(self) -> None:
+        """Release the hedge pool and any owned connection pool."""
+        with self._lock:
+            pool, self._hedge_exec = self._hedge_exec, None
+        if pool is not None:
+            pool.shutdown(wait=False, cancel_futures=True)
+        if self._owns_transport and self.transport is not None:
+            self.transport.close()
 
     def _pick(self) -> str:
         with self._lock:
@@ -347,6 +403,17 @@ class ScanWorkerPool:
             self._next += 1
             return url
 
+    def _pick_other(self, avoid: str) -> str | None:
+        """A healthy worker other than ``avoid`` (the hedge target), or
+        None when the fleet has no alternative."""
+        with self._lock:
+            for _ in range(len(self.worker_urls)):
+                url = self.worker_urls[self._next % len(self.worker_urls)]
+                self._next += 1
+                if url != avoid and self.breaker.allow(url):
+                    return url
+        return None
+
     def _mark_dead(self, url: str) -> None:
         self.breaker.record_failure(url)
 
@@ -355,36 +422,159 @@ class ScanWorkerPool:
             {"Authorization": f"Bearer {self.token}"} if self.token else None
         )
 
+    # -- hedging ------------------------------------------------------------
+
+    def _effective_hedge_delay(self) -> float | None:
+        """Seconds to wait before racing a second worker, or None when
+        hedging is off (disabled, single worker, or adaptive mode
+        without enough RTT history yet)."""
+        d = self.hedge_delay_s
+        if d is None or d < 0 or len(self.worker_urls) < 2:
+            return None
+        if d > 0:
+            return d
+        with self._lock:
+            if len(self._rtts) < self.HEDGE_MIN_SAMPLES:
+                return None
+            s = sorted(self._rtts)
+        return max(s[int(0.95 * (len(s) - 1))], self.HEDGE_FLOOR_S)
+
+    def _hedge_pool(self) -> ThreadPoolExecutor:
+        with self._lock:
+            if self._hedge_exec is None:
+                # sized for the ingest pipeline's concurrent run_slice
+                # callers plus their hedges: a primary queued behind a
+                # full pool must be rare (and is hedge-gated below)
+                self._hedge_exec = ThreadPoolExecutor(
+                    max_workers=max(8, 2 * len(self.worker_urls)),
+                    thread_name_prefix="scan-hedge",
+                )
+            return self._hedge_exec
+
+    def _note_hedge(self) -> None:
+        with self._lock:
+            self._hedges += 1
+        note_hedge()  # process-wide transport.hedges counter
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "hedges": self._hedges,
+                "hedge_wins": self._hedge_wins,
+                "rtt_samples": len(self._rtts),
+            }
+
+    # -- the scan call ------------------------------------------------------
+
+    def _scan_once(self, url: str, body, headers) -> tuple[int, bytes]:
+        """One raw /scan exchange; successful RTTs feed the adaptive
+        hedge delay."""
+        t0 = time.perf_counter()
+        status, out = self._post_bytes(
+            f"{url}/scan", body, self.timeout_s, headers
+        )
+        if status == 200:
+            with self._lock:
+                self._rtts.append(time.perf_counter() - t0)
+        return status, out
+
+    def _settle(
+        self, url: str, status: int, out: bytes, last
+    ) -> tuple[bytes | None, Exception | None]:
+        """Breaker bookkeeping for one answered scan: the blob on 200,
+        else the WorkerError to remember."""
+        if status == 200:
+            self.breaker.record_success(url)
+            return out, last
+        err = WorkerError(f"{url}: http {status}: {out[:200]!r}")
+        if status in (401, 403):
+            self._mark_dead(url)
+        else:
+            # any other HTTP answer proves the worker is ALIVE
+            # (the breaker tracks reachability, not scan success —
+            # scan errors are handled by retry + local fallback);
+            # recording an outcome also releases a half-open probe
+            # so a 500-answering worker is not excluded forever
+            self.breaker.record_success(url)
+        return None, err
+
     def scan_blob(self, payload: SliceScanPayload) -> bytes:
         """One slice scan on some worker -> the shard's npz blob
         (columnar.dumps_index form), undecoded."""
-        doc = json.loads(payload.dumps())
+        # serialize ONCE: a bytes-capable transport ships these bytes
+        # verbatim; legacy injected transports still get the dict
+        body = (
+            payload.dumps().encode()
+            if self._bytes_ok
+            else json.loads(payload.dumps())
+        )
         headers = self._auth_headers()
         last: Exception | None = None
         for _attempt in range(self.retries + 1):
             url = self._pick()
-            try:
-                status, body = self._post_bytes(
-                    f"{url}/scan", doc, self.timeout_s, headers
-                )
-            except Exception as e:
-                last = WorkerError(f"{url}: {e}")
-                self._mark_dead(url)
+            delay = self._effective_hedge_delay()
+            if delay is None:
+                try:
+                    status, out = self._scan_once(url, body, headers)
+                except Exception as e:
+                    last = WorkerError(f"{url}: {e}")
+                    self._mark_dead(url)
+                    continue
+                got, last = self._settle(url, status, out, last)
+                if got is not None:
+                    return got
                 continue
-            if status == 200:
-                self.breaker.record_success(url)
-                return body
-            last = WorkerError(f"{url}: http {status}: {body[:200]!r}")
-            if status in (401, 403):
-                self._mark_dead(url)
-            else:
-                # any other HTTP answer proves the worker is ALIVE
-                # (the breaker tracks reachability, not scan success —
-                # scan errors are handled by retry + local fallback);
-                # recording an outcome also releases a half-open probe
-                # so a 500-answering worker is not excluded forever
-                self.breaker.record_success(url)
+            got, last = self._scan_hedged(url, body, headers, delay, last)
+            if got is not None:
+                return got
         raise last
+
+    def _scan_hedged(
+        self, url: str, body, headers, delay: float, last
+    ) -> tuple[bytes | None, Exception | None]:
+        """One hedged attempt: primary on a pool thread; if it has not
+        answered within ``delay``, race a second worker. First response
+        wins; the loser keeps running and is ignored."""
+        pool = self._hedge_pool()
+        started = threading.Event()
+
+        def primary():
+            # stamps actual start: under a saturated pool the submit
+            # may queue, and a queued primary must not trigger a hedge
+            # (the delay would measure queue wait, not the worker, and
+            # the hedge would pile more load onto the same full pool)
+            started.set()
+            return self._scan_once(url, body, headers)
+
+        futs = {pool.submit(primary): url}
+        done, _pending = futures_mod.wait(futs, timeout=delay)
+        if not done and started.is_set():
+            other = self._pick_other(url)
+            if other is not None:
+                self._note_hedge()
+                futs[
+                    pool.submit(self._scan_once, other, body, headers)
+                ] = other
+        pending = set(futs)
+        while pending:
+            done, pending = futures_mod.wait(
+                pending, return_when=futures_mod.FIRST_COMPLETED
+            )
+            for f in done:
+                u = futs[f]
+                try:
+                    status, out = f.result()
+                except Exception as e:
+                    last = WorkerError(f"{u}: {e}")
+                    self._mark_dead(u)
+                    continue
+                got, last = self._settle(u, status, out, last)
+                if got is not None:
+                    if u != url:  # the hedge beat the primary
+                        with self._lock:
+                            self._hedge_wins += 1
+                    return got, last
+        return None, last
 
     def scan(self, payload: SliceScanPayload):
         """One slice scan on some worker -> VariantIndexShard."""
@@ -396,15 +586,26 @@ class ScanWorkerPool:
     #: (possibly minutes-long) slice-scan timeout
     RELOAD_TIMEOUT_S = 10.0
 
-    def reload_workers(self, *, post=urllib_post) -> int:
+    def reload_workers(self, *, post=None) -> int:
         """Best-effort concurrent POST /reload to every worker
         (shared-storage fleets re-pin freshly ingested shards without a
         restart); returns how many workers acknowledged. Concurrent with
         a short timeout so one wedged worker cannot stall ingest
         completion, and non-200 answers (404 = reload_fn not wired,
         500 = reload failed) are logged — a fleet silently serving stale
-        shards is exactly the failure this call exists to prevent."""
+        shards is exactly the failure this call exists to prevent.
+
+        Outcomes feed the scan breaker: any HTTP answer proves the
+        worker reachable again (revival after a cooldown — e.g. an
+        operator fixed a bad token), except 401/403 which re-confirm
+        the auth failure; a transport error keeps/opens the circuit."""
         headers = self._auth_headers()
+        if post is None:
+            post = (
+                self.transport.post_json
+                if self.transport is not None
+                else urllib_post
+            )
 
         def one(url: str) -> bool:
             try:
@@ -413,7 +614,12 @@ class ScanWorkerPool:
                 )
             except Exception:
                 log.warning("worker %s reload failed", url, exc_info=True)
+                self._mark_dead(url)
                 return False
+            if status in (401, 403):
+                self._mark_dead(url)
+            else:
+                self.breaker.record_success(url)
             if status != 200:
                 log.warning(
                     "worker %s reload answered http %s: %s",
@@ -458,12 +664,13 @@ class DistributedEngine:
         timeout_s: float = 600.0,
         retries: int = 2,
         max_threads: int = 64,
-        post=urllib_post,
-        get=urllib_get,
+        post=None,
+        get=None,
         token: str = "",
         breaker: CircuitBreaker | None = None,
+        transport: PooledTransport | None = None,
     ):
-        from ..config import BeaconConfig
+        from ..config import BeaconConfig, TransportConfig
 
         # full VariantEngine interface: the API layer reads engine.config
         self.config = config or (
@@ -474,8 +681,26 @@ class DistributedEngine:
         self.timeout_s = timeout_s
         self.retries = retries
         self.max_threads = max_threads
-        self._post = post
-        self._get = get
+        tcfg = getattr(self.config, "transport", None) or TransportConfig()
+        self.transport_config = tcfg
+        # default data plane: the pooled keep-alive transport (one
+        # instance per engine — connections die with close()); injected
+        # post/get callables take precedence (test seams, gRPC swaps)
+        self._owns_transport = False
+        if (post is None or get is None) and transport is None:
+            transport = PooledTransport.from_config(tcfg)
+            self._owns_transport = True
+        self.transport = transport
+        self._post = post if post is not None else transport.post_json
+        self._get = get if get is not None else transport.get_json
+        # a bytes-capable transport receives the payload's serialized
+        # JSON verbatim (no dict round-trip on the hot path); legacy
+        # injected transports keep their dict contract
+        self._post_bytes_ok = bool(
+            getattr(self._post, "accepts_bytes", False)
+        )
+        self._short_circuits = 0
+        self._sc_lock = threading.Lock()
         # does the (possibly injected) transport accept a 4th headers
         # arg? Decided once here so the per-call path never plays
         # TypeError roulette with a swapped gRPC/DCN transport
@@ -552,18 +777,31 @@ class DistributedEngine:
         return warm() if warm else 0
 
     def register_metrics(self, registry) -> None:
-        """Coordinator telemetry: per-worker breaker series plus the
-        local engine's instruments (batcher, response cache, dispatch
-        counters) when one is wired."""
+        """Coordinator telemetry: per-worker breaker series, the data
+        plane's transport series (connection reuse, RTT histogram,
+        hedges) and short-circuit counter, plus the local engine's
+        instruments (batcher, response cache, dispatch counters) when
+        one is wired."""
         register_breaker_metrics(registry, lambda: self.breaker)
+        register_transport_metrics(registry)
+        register_dispatch_metrics(registry, lambda: self._short_circuits)
         reg = getattr(self.local, "register_metrics", None)
         if reg is not None:
             reg(registry)
 
+    @property
+    def short_circuits(self) -> int:
+        """Boolean fan-outs answered before the full worker drain."""
+        with self._sc_lock:
+            return self._short_circuits
+
     def close(self) -> None:
-        """Release the scatter pool (engines are long-lived; call this
-        when rebuilding one on config/route changes)."""
+        """Release the scatter pool and the pooled worker connections
+        (engines are long-lived; call this when rebuilding one on
+        config/route changes)."""
         self._pool.shutdown(wait=False, cancel_futures=True)
+        if self._owns_transport and self.transport is not None:
+            self.transport.close()
 
     def __enter__(self) -> "DistributedEngine":
         return self
@@ -659,7 +897,14 @@ class DistributedEngine:
             # window hasn't lapsed — don't spend timeout_s finding out
             annotate(breaker="open")
             raise CircuitOpen(f"worker {url}: circuit open")
-        doc = json.loads(payload.dumps())
+        # serialize ONCE: the pooled transport ships these bytes
+        # verbatim (the old path built a dict just for the transport to
+        # re-dumps it); injected dict-contract transports still get one
+        doc = (
+            payload.dumps().encode()
+            if self._post_bytes_ok
+            else json.loads(payload.dumps())
+        )
         # the request deadline is passed EXPLICITLY by search(): this
         # runs on a pool thread, where the submitting request's
         # thread-local scope is not visible
@@ -703,8 +948,6 @@ class DistributedEngine:
     def search(
         self, payload: VariantQueryPayload
     ) -> list[VariantSearchResponse]:
-        import dataclasses
-
         with span("dispatch.search") as sp:
             current_deadline().check("dispatch.search")
             routes = self.routes()
@@ -733,51 +976,118 @@ class DistributedEngine:
                 tasks.append(
                     (url, dataclasses.replace(payload, dataset_ids=ds_list))
                 )
+            # a boolean-granularity fan-out with no resultset detail
+            # requested is a logical OR: the first hit anywhere decides
+            # the answer, so the rest of the scatter is abandoned.
+            # include_datasets != NONE keeps the full drain — the
+            # caller asked for per-dataset responses, and engine-level
+            # parity with a single engine must hold for them
+            # (knob: transport.bool_short_circuit)
+            short_circuit_ok = (
+                payload.requested_granularity == "boolean"
+                and payload.include_datasets == "NONE"
+                and getattr(
+                    self.transport_config, "bool_short_circuit", True
+                )
+            )
+            short_circuited = False
             responses: list[VariantSearchResponse] = []
+            deadline = current_deadline()
+            futures: dict = {}
             if tasks:
-                # await every future before raising: a fast-failing
-                # worker must not strand slow siblings' tasks in the
-                # shared pool (they'd hold threads for up to timeout_s
-                # and starve concurrent searches). The drain itself is
-                # deadline-bounded: a hung worker call must not hold
-                # THIS thread past the request's deadline — on expiry
-                # the still-running futures are left to finish on the
-                # pool (bounded by their own clamped urllib timeouts)
-                # and the caller gets DeadlineExceeded now.
-                deadline = current_deadline()
                 ctx = current_context()
-                futures = [
-                    self._pool.submit(self._call_worker, *t, deadline, ctx)
+                futures = {
+                    self._pool.submit(self._call_worker, *t, deadline, ctx): t[0]
                     for t in tasks
-                ]
-                first_err: BaseException | None = None
-                for f in futures:
-                    try:
-                        responses.extend(
-                            f.result(timeout=deadline.remaining())
+                }
+            # the LOCAL shard search runs on this thread CONCURRENTLY
+            # with the worker fan-out (it used to wait for the full
+            # drain) — the coordinator's own datasets no longer sit
+            # behind the slowest worker's RTT
+            first_err: BaseException | None = None
+            if local_wanted:
+                try:
+                    responses.extend(
+                        self.local.search(
+                            dataclasses.replace(
+                                payload, dataset_ids=local_wanted
+                            )
                         )
-                    except futures_mod.TimeoutError:
+                    )
+                except Exception as e:
+                    # recorded, not raised: the worker futures must
+                    # still be drained (stranded tasks starve the pool)
+                    first_err = e
+            pending = set(futures)
+            # hit_seen is order-independent: once ANY leg of a boolean
+            # OR reports a hit, the aggregate answer is decided — a
+            # sibling's error cannot change it and must not fail the
+            # query, whether it arrived before or after the hit
+            hit_seen = short_circuit_ok and any(
+                r.exists for r in responses
+            )
+            if not hit_seen:
+                # fan-in consumes futures AS COMPLETED (incremental
+                # aggregation, a hit can short-circuit) but still
+                # settles every one before raising: a fast-failing
+                # worker must not strand slow siblings' tasks in the
+                # shared pool. The drain is deadline-bounded: on expiry
+                # still-running futures are left to finish on the pool
+                # (bounded by their own clamped socket timeouts) and
+                # the caller gets DeadlineExceeded now.
+                while pending:
+                    done, pending = futures_mod.wait(
+                        pending,
+                        timeout=deadline.remaining(),
+                        return_when=futures_mod.FIRST_COMPLETED,
+                    )
+                    if not done:  # deadline expired mid-drain
                         if first_err is None:
                             first_err = DeadlineExceeded(
                                 "worker fan-in: deadline exceeded"
                             )
-                    except (Exception, futures_mod.CancelledError) as e:
-                        # CancelledError (close() mid-search) is a
-                        # BaseException: it must not abort the drain
-                        if first_err is None:
-                            first_err = e
-                if first_err is not None:
-                    raise first_err
-            if local_wanted:
-                responses.extend(
-                    self.local.search(
-                        dataclasses.replace(
-                            payload, dataset_ids=local_wanted
-                        )
-                    )
-                )
+                        break
+                    for f in done:
+                        try:
+                            out = f.result()
+                        except (
+                            Exception,
+                            futures_mod.CancelledError,
+                        ) as e:
+                            # CancelledError (close() mid-search) is a
+                            # BaseException: it must not abort the drain
+                            if first_err is None:
+                                first_err = e
+                        else:
+                            responses.extend(out)
+                            if short_circuit_ok and any(
+                                r.exists for r in out
+                            ):
+                                hit_seen = True
+                    if hit_seen:
+                        break
+            if hit_seen:
+                if pending:
+                    # abandon the rest of the scatter: queued futures
+                    # are cancelled outright, in-flight ones finish on
+                    # the pool and are ignored — for a boolean query
+                    # their answers cannot change the aggregate. The
+                    # counter only ticks when a drain was actually cut
+                    # short.
+                    for f in pending:
+                        f.cancel()
+                    short_circuited = True
+                    with self._sc_lock:
+                        self._short_circuits += 1
+                    annotate(short_circuit=True)
+            elif first_err is not None:
+                raise first_err
             responses.sort(key=lambda r: (r.dataset_id, r.vcf_location))
-            sp.note(workers=len(tasks), responses=len(responses))
+            sp.note(
+                workers=len(tasks),
+                responses=len(responses),
+                short_circuit=short_circuited,
+            )
         return responses
 
 

@@ -245,6 +245,144 @@ def test_fast_failure_awaits_slow_siblings():
     dist.close()
 
 
+def test_local_search_runs_concurrently_with_fanout():
+    """The coordinator's local-shard search overlaps the worker
+    scatter (ISSUE 5): two 0.3 s legs must not cost 0.6 s."""
+    import dataclasses
+    import time
+
+    from sbeacon_tpu.payloads import VariantSearchResponse
+
+    leg_s = 0.3
+
+    class FakeLocal:
+        def datasets(self):
+            return ["dsL"]
+
+        def search(self, payload):
+            time.sleep(leg_s)
+            return [
+                VariantSearchResponse(
+                    dataset_id="dsL", vcf_location="v", exists=False
+                )
+            ]
+
+    def post(url, doc, timeout_s, headers=None):
+        time.sleep(leg_s)
+        return 200, {"responses": [
+            {"dataset_id": "dsW", "vcf_location": "v", "exists": False}
+        ]}
+
+    def get(url, timeout_s, headers=None):
+        return 200, {"datasets": ["dsW"], "fingerprint": "f"}
+
+    dist = DistributedEngine(
+        ["http://w:1"],
+        retries=0,
+        post=post,
+        get=get,
+        config=BeaconConfig(),
+        local=FakeLocal(),
+    )
+    try:
+        t0 = time.perf_counter()
+        got = dist.search(
+            dataclasses.replace(PAYLOAD, dataset_ids=["dsL", "dsW"])
+        )
+        took = time.perf_counter() - t0
+        assert {r.dataset_id for r in got} == {"dsL", "dsW"}
+        assert took < leg_s * 1.7, took  # overlapped, not sequential
+    finally:
+        dist.close()
+
+
+def test_bool_hit_beats_sibling_error_regardless_of_order():
+    """A boolean OR is decided by any hit: a sibling worker's error
+    must not fail the query even when the error lands FIRST and the hit
+    arrives last (order-independence of the short-circuit logic)."""
+    import dataclasses
+    import time
+
+    def post(url, doc, timeout_s, headers=None):
+        if "werr" in url:
+            raise OSError("injected: down")  # fails immediately
+        time.sleep(0.15)  # the hit arrives after the error
+        return 200, {"responses": [
+            {"dataset_id": "dH", "vcf_location": "v", "exists": True}
+        ]}
+
+    def get(url, timeout_s, headers=None):
+        ds = "dE" if "werr" in url else "dH"
+        return 200, {"datasets": [ds], "fingerprint": ds}
+
+    dist = DistributedEngine(
+        ["http://werr:1", "http://whit:1"], retries=0, post=post, get=get
+    )
+    try:
+        pay = dataclasses.replace(
+            PAYLOAD,
+            dataset_ids=["dE", "dH"],
+            include_datasets="NONE",
+            requested_granularity="boolean",
+        )
+        got = dist.search(pay)  # must NOT raise WorkerError
+        assert any(r.exists for r in got)
+        # nothing was abandoned (the error future had already settled),
+        # so the short-circuit counter must not inflate
+        assert dist.short_circuits == 0
+    finally:
+        dist.close()
+
+
+def test_bool_short_circuit_honors_config_toggle():
+    """transport.bool_short_circuit=False keeps the full drain even for
+    boolean-granularity fan-outs."""
+    import dataclasses
+    import time
+
+    from sbeacon_tpu.config import TransportConfig
+
+    slow_s = 0.3
+
+    def post(url, doc, timeout_s, headers=None):
+        if "whit" in url:
+            return 200, {"responses": [
+                {"dataset_id": "dH", "vcf_location": "v", "exists": True}
+            ]}
+        time.sleep(slow_s)
+        return 200, {"responses": [
+            {"dataset_id": "dS", "vcf_location": "v", "exists": False}
+        ]}
+
+    def get(url, timeout_s, headers=None):
+        ds = "dH" if "whit" in url else "dS"
+        return 200, {"datasets": [ds], "fingerprint": ds}
+
+    cfg = BeaconConfig(transport=TransportConfig(bool_short_circuit=False))
+    dist = DistributedEngine(
+        ["http://whit:1", "http://wslow:1"],
+        retries=0,
+        post=post,
+        get=get,
+        config=cfg,
+    )
+    try:
+        pay = dataclasses.replace(
+            PAYLOAD,
+            dataset_ids=["dH", "dS"],
+            include_datasets="NONE",
+            requested_granularity="boolean",
+        )
+        t0 = time.perf_counter()
+        got = dist.search(pay)
+        took = time.perf_counter() - t0
+        assert {r.dataset_id for r in got} == {"dH", "dS"}  # full drain
+        assert took >= slow_s * 0.9, took
+        assert dist.short_circuits == 0
+    finally:
+        dist.close()
+
+
 def test_engine_close_releases_pools(cluster):
     w1, _ = cluster
     dist = DistributedEngine([w1.address])
@@ -265,11 +403,11 @@ def test_worker_token_gates_requests():
     try:
         status, _ = urllib_get(f"{w.address}/health", 5)
         assert status == 200
-        import urllib.error
-
-        with pytest.raises(urllib.error.HTTPError) as ei:
-            urllib_get(f"{w.address}/datasets", 5)
-        assert ei.value.code == 401
+        # ISSUE 5 satellite regression guard: a 401 on a GET returns
+        # (status, body) like urllib_post — it must NOT raise, so the
+        # breaker can count the answer as worker-alive
+        status, doc = urllib_get(f"{w.address}/datasets", 5)
+        assert status == 401 and "error" in doc
         status, doc = urllib_post(
             f"{w.address}/search", PAYLOAD.__dict__ | {}, 5
         )

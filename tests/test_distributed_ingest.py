@@ -262,6 +262,77 @@ def test_cooldown_skips_failing_worker(vcf):
         w.shutdown()
 
 
+def test_auth_failure_marks_dead_and_reload_revives(vcf):
+    """401/403 on /scan opens the worker's circuit (_mark_dead); a
+    later successful /reload — e.g. after an operator fixes the token —
+    records the worker reachable again and closes it (ISSUE 5 satellite:
+    previously-untested liveness bookkeeping)."""
+    path, _ = vcf
+    eng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    w = WorkerServer(
+        eng, token="tok", reload_fn=lambda: 0
+    ).start_background()
+    try:
+        pool = ScanWorkerPool(
+            [w.address], token="wrong", retries=0, cooldown_s=300
+        )
+        payload = SliceScanPayload(
+            dataset_id="d", vcf_location=str(path),
+            vstart=0, vend=1 << 40, sample_names=SAMPLES,
+        )
+        with pytest.raises(WorkerError):
+            pool.scan_blob(payload)
+        assert pool.breaker.state(w.address) == "open"
+        # a reload that still fails auth keeps the circuit open
+        assert pool.reload_workers() == 0
+        assert pool.breaker.state(w.address) == "open"
+        # operator fixes the token: the acknowledged reload revives it
+        pool.token = "tok"
+        assert pool.reload_workers() == 1
+        assert pool.breaker.state(w.address) == "closed"
+        assert pool._pick() == w.address
+        pool.close()
+    finally:
+        w.shutdown()
+
+
+def test_half_open_probe_released_on_non200_answer():
+    """A worker that ANSWERS (even 500) after its cooldown proves it is
+    reachable: the half-open probe must record an outcome and close the
+    circuit, not strand it open forever (ISSUE 5 satellite: untested
+    path in the breaker bookkeeping)."""
+    from sbeacon_tpu.resilience import CircuitBreaker
+
+    url = "http://w:1"
+    mode = {"raise": True}
+
+    def post_bytes(u, doc, timeout_s, headers=None):
+        if mode["raise"]:
+            raise ConnectionError("injected: down")
+        return 500, b"scan exploded"
+
+    pool = ScanWorkerPool([url], retries=0, post_bytes=post_bytes)
+    clock = [0.0]
+    pool.breaker = CircuitBreaker(
+        failure_threshold=1, reset_timeout_s=30.0, clock=lambda: clock[0]
+    )
+    payload = SliceScanPayload(dataset_id="d")
+    with pytest.raises(WorkerError):
+        pool.scan_blob(payload)
+    assert pool.breaker.state(url) == "open"
+    # cooldown lapses; the worker now answers 500: still a WorkerError
+    # for THIS scan (retry + local fallback own correctness), but the
+    # probe outcome closes the circuit — reachability is what it tracks
+    clock[0] = 31.0
+    mode["raise"] = False
+    with pytest.raises(WorkerError):
+        pool.scan_blob(payload)
+    assert pool.breaker.state(url) == "closed"
+    pool.close()
+
+
 def test_worker_reload_pins_new_shards(vcf, tmp_path):
     """Shared-storage serving: after the coordinator ingests into the
     worker's data root, POST /reload re-pins the new shards without a

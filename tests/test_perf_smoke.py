@@ -104,6 +104,153 @@ def test_warm_cache_hit_is_zero_launches():
         eng.close()
 
 
+# -- coordinator-worker data plane (ISSUE 5) ----------------------------------
+
+
+def _worker_payload(granularity="boolean", include="NONE", datasets=()):
+    return VariantQueryPayload(
+        dataset_ids=list(datasets),
+        reference_name="1",
+        start_min=1,
+        start_max=1 << 30,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        requested_granularity=granularity,
+        include_datasets=include,
+    )
+
+
+@pytest.mark.perf_smoke
+def test_sequential_worker_calls_bounded_by_pool_size():
+    """N sequential coordinator->worker calls must ride pooled
+    keep-alive connections: the worker accepts at most pool_size TCP
+    connections, not one per call (the pre-ISSUE-5 behavior)."""
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+    from sbeacon_tpu.parallel.transport import PooledTransport
+
+    eng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    rng = random.Random(77)
+    eng.add_index(
+        build_index(
+            random_records(rng, chrom="1", n=120, n_samples=2),
+            dataset_id="dsP",
+            vcf_location="p.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+    )
+    w = WorkerServer(eng).start_background()
+    accepts = [0]
+    orig = w.server.get_request
+
+    def counting_get_request():
+        accepts[0] += 1
+        return orig()
+
+    w.server.get_request = counting_get_request
+    transport = PooledTransport(pool_size=2)
+    dist = DistributedEngine([w.address], transport=transport)
+    n_calls = 6
+    try:
+        for _ in range(n_calls):
+            got = dist.search(_worker_payload(datasets=["dsP"]))
+            assert got and got[0].exists
+        # discovery GET + 6 searches all rode pooled connections
+        assert accepts[0] <= transport.pool_size, accepts
+        assert accepts[0] < n_calls
+        assert transport.metrics()["reused"] >= n_calls - 1
+    finally:
+        dist.close()
+        w.shutdown()
+        eng.close()
+
+
+@pytest.mark.perf_smoke
+def test_boolean_short_circuit_over_three_workers():
+    """A boolean-granularity fan-out over >=3 workers returns as soon
+    as any worker reports a hit — the slow siblings are abandoned and
+    dispatch.short_circuits increments."""
+    import time
+
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine
+
+    slow_s = 0.6
+    urls = ["http://wslow1:1", "http://wslow2:1", "http://whit:1"]
+
+    def post(url, doc, timeout_s, headers=None):
+        base = url.rsplit("/", 1)[0]  # strip /search
+        if "whit" in url:
+            return 200, {
+                "responses": [
+                    {
+                        "dataset_id": f"ds::{base}",
+                        "vcf_location": "v",
+                        "exists": True,
+                    }
+                ]
+            }
+        time.sleep(slow_s)
+        return 200, {"responses": [
+            {"dataset_id": f"ds::{base}", "vcf_location": "v",
+             "exists": False}
+        ]}
+
+    def get(url, timeout_s, headers=None):
+        base = url.rsplit("/", 1)[0]  # strip /datasets
+        return 200, {"datasets": [f"ds::{base}"], "fingerprint": base}
+
+    dist = DistributedEngine(urls, retries=0, post=post, get=get)
+    try:
+        t0 = time.perf_counter()
+        got = dist.search(
+            _worker_payload(datasets=[f"ds::{u}" for u in urls])
+        )
+        took = time.perf_counter() - t0
+        assert any(r.exists for r in got)
+        assert took < slow_s * 0.8, took  # did NOT wait for the drain
+        assert dist.short_circuits == 1
+    finally:
+        dist.close()
+
+
+@pytest.mark.perf_smoke
+def test_hedged_scan_not_gated_by_slow_worker():
+    """A seeded-slow worker must not gate scan_blob completion: after
+    the hedge delay the scan races a second worker and the first
+    response wins."""
+    import time
+
+    from sbeacon_tpu.parallel.dispatch import ScanWorkerPool
+    from sbeacon_tpu.payloads import SliceScanPayload
+
+    slow_s = 0.8
+
+    def post_bytes(url, doc, timeout_s, headers=None):
+        if "slow" in url:
+            time.sleep(slow_s)
+            return 200, b"blob-slow"
+        return 200, b"blob-fast"
+
+    pool = ScanWorkerPool(
+        ["http://slow:1", "http://fast:1"],
+        retries=0,
+        hedge_delay_s=0.05,
+        post_bytes=post_bytes,
+    )
+    try:
+        t0 = time.perf_counter()
+        blob = pool.scan_blob(SliceScanPayload(dataset_id="d"))
+        took = time.perf_counter() - t0
+        assert blob == b"blob-fast"
+        assert took < slow_s * 0.8, took
+        stats = pool.stats()
+        assert stats["hedges"] == 1 and stats["hedge_wins"] == 1
+    finally:
+        pool.close()
+
+
 @pytest.mark.perf_smoke
 def test_cache_disabled_still_fuses():
     """response_cache=False keeps the fused single-launch contract and

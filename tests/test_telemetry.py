@@ -166,6 +166,14 @@ GOLDEN_METRICS = [
     "response_cache.evictions",
     "response_cache.expirations",
     "response_cache.invalidations",
+    "transport.conn.opened",
+    "transport.conn.reused",
+    "transport.conn.evicted",
+    "transport.conn.retried",
+    "transport.gzip_bodies",
+    "transport.hedges",
+    "transport.rtt_ms",
+    "dispatch.short_circuits",
     "breaker.state",
     "breaker.consecutive_failures",
     "breaker.opens",

@@ -172,7 +172,7 @@ def test_legacy_3arg_transport_survives_ambient_context():
         )
         with request_context(RequestContext()):
             got = dist.search(dataclasses.replace(pay))
-        assert got == [] and calls == ["http://w:1"]
+        assert got == [] and calls == ["http://w:1/search"]
         # and a 4-arg transport under the same context DOES get the id
         seen = {}
 

@@ -1,0 +1,81 @@
+#!/usr/bin/env python
+"""Static transport lint for the coordinator-worker data plane.
+
+After ISSUE 5 every coordinator->worker HTTP call rides the pooled
+keep-alive transport (``sbeacon_tpu/parallel/transport.py``). A future
+call site that reaches for ``urllib.request.urlopen`` silently
+regresses to one TCP handshake per call — exactly the per-call tail
+that PR removed — so this lint fails when a direct ``urlopen`` use
+appears anywhere under ``sbeacon_tpu/`` outside the allowlist:
+
+- ``parallel/transport.py`` — the owner (also hosts the unpooled
+  ``urllib_*`` fallbacks kept as injectable seams),
+- ``io/sources.py`` and ``metadata/resolvers.py`` — external-service
+  clients (object-store ranged GETs, OLS/Ontoserver resolution): not
+  the worker data plane, each manages its own connection strategy.
+
+Run directly (``python tools/check_transport_usage.py``) or via the
+tier-1 test ``tests/test_transport.py::test_transport_usage_lint``
+(mirroring ``tools/check_metric_names.py``).
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+
+#: package-relative paths allowed to touch urllib.request.urlopen
+ALLOWED = {
+    "parallel/transport.py",
+    "io/sources.py",
+    "metadata/resolvers.py",
+}
+
+#: direct urlopen use in any spelling: qualified calls and imports that
+#: would let a bare ``urlopen(`` appear later
+PATTERN = re.compile(
+    r"urllib\s*\.\s*request\s*\.\s*urlopen"
+    r"|(?<![\w.])request\.urlopen\s*\("
+    r"|from\s+urllib\.request\s+import\s+[^\n]*\burlopen\b"
+)
+
+
+def scan(root: Path = PKG) -> list[str]:
+    """["file:line: matched text"] for every disallowed urlopen use."""
+    hits = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel in ALLOWED:
+            continue
+        src = path.read_text()
+        for m in PATTERN.finditer(src):
+            line = src[: m.start()].count("\n") + 1
+            hits.append(
+                f"sbeacon_tpu/{rel}:{line}: {m.group(0)!r} — route "
+                "worker-plane HTTP through parallel/transport.py "
+                "(pooled keep-alive), or add this file to the "
+                "documented allowlist"
+            )
+    return hits
+
+
+def main() -> int:
+    hits = scan()
+    if hits:
+        for h in hits:
+            print(f"ERROR: {h}")
+        return 1
+    # the owner must still exist — an empty scan because transport.py
+    # was deleted would be a false pass
+    if not (PKG / "parallel" / "transport.py").exists():
+        print("ERROR: sbeacon_tpu/parallel/transport.py is missing")
+        return 1
+    print("ok: no direct urlopen use outside the transport allowlist")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
