@@ -1261,12 +1261,75 @@ def config10_fanout():
             "won_by_hedge": blob == b"blob-fast",
             **pool.stats(),
         }
+        # failover probe (ISSUE 6): a 2-replica dataset with its primary
+        # killed mid-stream — the added p50/p99 vs. the healthy baseline
+        # is the failover walk (first calls pay a refused connect, then
+        # the breaker opens and routing avoids the corpse), not an outage
+        rep_recs = random_records(
+            _random.Random(950), chrom="1", n=2000, n_samples=2
+        )
+
+        def rep_engine():
+            eng = VariantEngine(
+                BeaconConfig(
+                    engine=EngineConfig(
+                        microbatch=False, use_mesh=False, device_planes=False
+                    )
+                )
+            )
+            eng.add_index(
+                build_index(
+                    rep_recs,
+                    dataset_id="rep0",
+                    vcf_location="rep0.vcf.gz",
+                    sample_names=["S0", "S1"],
+                )
+            )
+            return eng
+
+        reps = [WorkerServer(rep_engine()).start_background() for _ in range(2)]
+        workers.extend(reps)
+        dist2 = DistributedEngine(
+            [w.address for w in reps], retries=0, timeout_s=10.0
+        )
+        try:
+            rep_pay = payload("count", "HIT", ["rep0"])
+            dist2.search(rep_pay)  # warm + discovery
+
+            def quantiles(n=40):
+                ts = []
+                for _ in range(n):
+                    t0 = time.perf_counter()
+                    dist2.search(rep_pay)
+                    ts.append((time.perf_counter() - t0) * 1e3)
+                ts.sort()
+                return ts[len(ts) // 2], ts[int(len(ts) * 0.99)]
+
+            h50, h99 = quantiles()
+            primary = dist2.router.pick("rep0")
+            next(w for w in reps if w.address == primary).shutdown()
+            d50, d99 = quantiles()
+            out["failover"] = {
+                "healthy_p50_ms": round(h50, 3),
+                "healthy_p99_ms": round(h99, 3),
+                "primary_down_p50_ms": round(d50, 3),
+                "primary_down_p99_ms": round(d99, 3),
+                "failovers": dist2.dispatch_stats()["failovers"],
+                "partial_responses": dist2.dispatch_stats()[
+                    "partial_responses"
+                ],
+            }
+        finally:
+            dist2.close()
     finally:
         dist.close()
         if pool is not None:
             pool.close()
         for w in workers:
-            w.shutdown()
+            try:
+                w.shutdown()
+            except Exception:
+                pass
     return out
 
 

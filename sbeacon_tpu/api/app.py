@@ -303,7 +303,7 @@ class BeaconApp:
             register_transport_metrics(reg)
             register_dispatch_metrics(
                 reg,
-                lambda: getattr(self.engine, "short_circuits", 0),
+                lambda: getattr(self.engine, "dispatch_stats", dict)(),
             )
 
     #: bounded route-label set for the latency histogram — unknown
@@ -378,6 +378,18 @@ class BeaconApp:
             if isinstance(meta, dict):
                 meta["traceId"] = ctx.trace_id
                 meta["elapsedTimeMs"] = round(elapsed_ms, 2)
+                unavailable = ctx.notes.get("unavailable_datasets")
+                if unavailable:
+                    # partial-results degradation (dispatch.search):
+                    # every replica of these datasets was unreachable,
+                    # so the response covers the datasets that
+                    # answered — say so instead of 502ing the request
+                    meta["unavailableDatasets"] = list(unavailable)
+                    meta.setdefault("warnings", []).append(
+                        "no reachable replica for dataset(s): "
+                        + ", ".join(unavailable)
+                        + "; results are partial"
+                    )
         return status, payload
 
     def _handle(
@@ -463,6 +475,14 @@ class BeaconApp:
                 "shards": len(getattr(local, "_indexes", {})),
                 "inFlight": self.admission.metrics()["in_flight"],
             }
+            # degraded datasets (every replica's circuit open) are
+            # reported but do NOT flip readiness: the server still
+            # serves everything else, with partial-results envelopes
+            # naming the rest — pulling it from rotation would turn a
+            # partial outage into a total one
+            degraded = getattr(self.engine, "unavailable_datasets", None)
+            if degraded is not None:
+                body["degradedDatasets"] = degraded()
             return (200 if self.ready else 503), body
         # /metrics: content negotiation — ?format=prometheus or
         # ``Accept: text/plain`` gets the exposition text (the transport

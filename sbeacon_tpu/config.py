@@ -224,6 +224,13 @@ class ResilienceConfig:
     runner_workers / runner_max_pending: the async query runner's
       bounded pool (replaces thread-per-query) and its shed threshold.
     breaker_*: consecutive-failure circuit breaker on per-worker routes.
+    failover_retries: extra replicas a failed worker-search leg may
+      re-route to (never the same copy twice) before its datasets fall
+      to the partial-results path.
+    partial_results: when no replica of a dataset is reachable, answer
+      with the datasets that responded and mark the rest in the
+      envelope (``meta.unavailableDatasets`` + a warning) instead of
+      failing the whole request; off restores fail-the-query semantics.
     """
 
     default_deadline_s: float = 60.0
@@ -235,6 +242,8 @@ class ResilienceConfig:
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
     breaker_half_open_probes: int = 1
+    failover_retries: int = 2
+    partial_results: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -252,13 +261,18 @@ class TransportConfig:
       next touch (workers reap their side slightly later).
     gzip_min_bytes: request bodies at or over this size are
       gzip-compressed on the wire (0 disables).
-    hedge_delay_s: slice-scan hedging (Dean & Barroso, The Tail at
-      Scale): if a scan's primary worker has not answered within this
-      delay, the same scan is raced on a second worker and the first
+    hedge_delay_s: request hedging (Dean & Barroso, The Tail at
+      Scale): if a call's primary worker has not answered within this
+      delay, the same call is raced on a second worker and the first
       response wins. >0 = fixed delay; 0 = adaptive (the p95 of recent
-      scan RTTs, once enough samples exist); <0 disables.
+      RTTs, once enough samples exist); <0 disables. Governs both
+      ingest slice scans and (with ``replica_hedge``) full /search
+      calls across replicas.
     bool_short_circuit: boolean-granularity fan-outs return as soon as
       any worker reports a hit, abandoning the rest of the scatter.
+    replica_hedge: hedge slow /search primaries with a second replica
+      of the same datasets (``hedge_delay_s`` semantics unchanged);
+      single-replica fleets never hedge.
     """
 
     pool_size: int = 4
@@ -266,6 +280,7 @@ class TransportConfig:
     gzip_min_bytes: int = 32 * 1024
     hedge_delay_s: float = 0.0
     bool_short_circuit: bool = True
+    replica_hedge: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -421,10 +436,15 @@ class BeaconConfig:
             "BEACON_BREAKER_THRESHOLD": ("breaker_failure_threshold", int),
             "BEACON_BREAKER_RESET_S": ("breaker_reset_s", float),
             "BEACON_BREAKER_PROBES": ("breaker_half_open_probes", int),
+            "BEACON_FAILOVER_RETRIES": ("failover_retries", int),
         }
         for var, (field, conv) in _res_env.items():
             if var in env:
                 res_over[field] = conv(env[var])
+        if "BEACON_PARTIAL_RESULTS" in env:
+            res_over["partial_results"] = (
+                env["BEACON_PARTIAL_RESULTS"].lower() not in _off
+            )
         resilience = ResilienceConfig(**res_over)
         tr_over: dict = {}
         _tr_env = {
@@ -439,6 +459,10 @@ class BeaconConfig:
         if "BEACON_BOOL_SHORT_CIRCUIT" in env:
             tr_over["bool_short_circuit"] = (
                 env["BEACON_BOOL_SHORT_CIRCUIT"].lower() not in _off
+            )
+        if "BEACON_REPLICA_HEDGE" in env:
+            tr_over["replica_hedge"] = (
+                env["BEACON_REPLICA_HEDGE"].lower() not in _off
             )
         transport = TransportConfig(**tr_over)
         obs_over: dict = {}

@@ -963,6 +963,22 @@ class VariantEngine:
         recomputed on the query hot path."""
         return self._fingerprint
 
+    def dataset_fingerprints(self) -> dict[str, str]:
+        """Per-dataset identity — the same ``vcf|variant_count|
+        call_count|n_rows`` components :meth:`index_fingerprint` folds,
+        grouped by dataset. The worker ``/datasets`` endpoint serves
+        this so a coordinator groups only IDENTICAL shard copies as
+        replicas and routes around a worker serving a stale copy
+        (dispatch._group_replicas)."""
+        out: dict[str, str] = {}
+        for (ds, vcf), (s, *_r) in sorted(self._indexes.items()):
+            part = (
+                f"{vcf}|{s.meta.get('variant_count')}"
+                f"|{s.meta.get('call_count')}|{s.n_rows}"
+            )
+            out[ds] = f"{out[ds]}&{part}" if ds in out else part
+        return out
+
     def indexes_for(self, dataset_ids: list[str]):
         for (ds, vcf), pair in sorted(self._indexes.items()):
             if not dataset_ids or ds in dataset_ids:

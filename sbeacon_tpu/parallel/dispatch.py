@@ -13,7 +13,14 @@ workers owning its datasets (thread-pool scatter, the reference's
 ThreadPoolExecutor(500) shape), retries transient failures (the
 reference's 10x save / retry loops), and merges the per-(dataset,vcf)
 response lists — presenting the exact ``VariantEngine`` interface so the
-API layer, job table, and micro-batcher compose unchanged.
+API layer, job table, and micro-batcher compose unchanged. Datasets
+served by several workers keep their full replica list
+(:class:`ReplicaRouter`): power-of-two-choices routing over recent
+RTTs, failover to the next replica on worker errors or open circuits,
+replica-hedged searches for slow primaries, partial-results
+degradation when every copy is down, and a background rediscovery loop
+that heals routes — the fault tolerance the reference inherited from
+Lambda invoke retries, made explicit.
 
 Transport is stdlib HTTP+JSON (the payload types' stable dict form)
 over the pooled keep-alive layer in ``transport.py`` (per-worker
@@ -31,6 +38,7 @@ import gzip
 import hmac
 import json
 import logging
+import random
 import threading
 import time
 import urllib.error
@@ -53,6 +61,8 @@ from ..payloads import (
     VariantSearchResponse,
 )
 from ..resilience import (
+    CLOSED,
+    OPEN,
     CircuitBreaker,
     CircuitOpen,
     DeadlineExceeded,
@@ -126,11 +136,19 @@ def _make_handler(
             elif not self._authorized():
                 self._send(401, {"error": "unauthorized"})
             elif self.path == "/datasets":
+                # per-dataset fingerprints let the coordinator group
+                # only IDENTICAL shard copies as replicas (a worker
+                # serving a stale copy of one dataset must not be
+                # treated as interchangeable with a fresh one)
+                ds_fps = getattr(engine, "dataset_fingerprints", None)
                 self._send(
                     200,
                     {
                         "datasets": engine.datasets(),
                         "fingerprint": engine.index_fingerprint(),
+                        "dataset_fingerprints": (
+                            ds_fps() if ds_fps is not None else {}
+                        ),
                     },
                 )
             else:
@@ -243,6 +261,59 @@ def _make_handler(
     return Handler
 
 
+class _WorkerHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that tracks live client connections so
+    shutdown can sever them. A killed worker process takes every
+    socket with it; ``server_close`` alone only closes the LISTENER,
+    leaving keep-alive handler threads answering on pooled
+    coordinator connections — a zombie that would mask exactly the
+    dead-worker failover paths the replica layer (and its tests)
+    exist for."""
+
+    daemon_threads = True
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._conn_lock = threading.Lock()
+        self._conns: set = set()
+
+    def process_request(self, request, client_address):
+        with self._conn_lock:
+            self._conns.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._conn_lock:
+            self._conns.discard(request)
+        super().shutdown_request(request)
+
+    def handle_error(self, request, client_address):
+        # a handler mid-write when close_all_connections severed its
+        # socket raises BrokenPipe/ConnectionReset — that IS the
+        # faithful kill, not an error worth a stderr traceback
+        import sys
+
+        exc = sys.exc_info()[1]
+        if isinstance(exc, (BrokenPipeError, ConnectionResetError)):
+            return
+        super().handle_error(request, client_address)
+
+    def close_all_connections(self) -> None:
+        import socket as socket_mod
+
+        with self._conn_lock:
+            conns, self._conns = list(self._conns), set()
+        for sock in conns:
+            try:
+                sock.shutdown(socket_mod.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
 class WorkerServer:
     """One worker host's engine behind HTTP (the performQuery leaf's
     process boundary, minus SNS)."""
@@ -258,7 +329,7 @@ class WorkerServer:
         reload_fn=None,
     ):
         self.engine = engine
-        self.server = ThreadingHTTPServer(
+        self.server = _WorkerHTTPServer(
             (host, port),
             _make_handler(engine, token, open_scan, reload_fn),
         )
@@ -279,6 +350,9 @@ class WorkerServer:
     def shutdown(self) -> None:
         self.server.shutdown()
         self.server.server_close()
+        # faithful kill: live keep-alive connections die with the
+        # server, like the process death they stand in for
+        self.server.close_all_connections()
 
 
 # -- coordinator side ---------------------------------------------------------
@@ -291,14 +365,175 @@ class WorkerServer:
 
 def register_dispatch_metrics(registry, supplier) -> None:
     """The coordinator fan-out's own series. ``supplier`` returns the
-    current short-circuit count (0 on single-host engines — the app's
-    fallback registration keeps the catalogue deployment-stable, like
-    the breaker series)."""
+    current :meth:`DistributedEngine.dispatch_stats` dict (empty on
+    single-host engines — the app's fallback registration keeps the
+    catalogue deployment-stable, like the breaker series)."""
+
+    def field(name):
+        return lambda: supplier().get(name, 0)
+
     registry.counter(
         "dispatch.short_circuits",
         "boolean fan-outs answered before the full worker drain",
-        fn=supplier,
+        fn=field("short_circuits"),
     )
+    registry.counter(
+        "dispatch.failovers",
+        "worker search legs re-routed to another replica after a failure",
+        fn=field("failovers"),
+    )
+    registry.counter(
+        "dispatch.partial_responses",
+        "searches answered partially with some datasets unavailable",
+        fn=field("partial_responses"),
+    )
+    registry.gauge(
+        "routing.replicas",
+        "replica routes in the table (sum of copies across datasets)",
+        fn=field("replicas"),
+    )
+    registry.counter(
+        "routing.rediscoveries",
+        "background route-rediscovery passes run to heal dead routes",
+        fn=field("rediscoveries"),
+    )
+
+
+def _fingerprint_freshness(fp: str) -> int:
+    """Total indexed rows encoded in a per-dataset fingerprint (the
+    ``vcf|variant_count|call_count|n_rows`` parts joined by ``&``) —
+    the 'newer copy' heuristic for divergent replicas: re-ingestion
+    only grows a dataset's row count, so when two workers advertise
+    the same dataset with different fingerprints the larger copy is
+    the one that saw the latest publish. Only the exact 4-field
+    per-dataset shape parses; anything else sorts oldest — in
+    particular a legacy worker's ENGINE-WIDE fallback string
+    (``ds|vcf|vc|cc|rows`` 5-field parts spanning its whole corpus)
+    must lose to real per-dataset identity, not out-freshen it by
+    summing rows across unrelated datasets."""
+    total = 0
+    for part in fp.split("&"):
+        fields = part.split("|")
+        if len(fields) != 4:
+            return -1
+        try:
+            total += int(fields[-1])
+        except ValueError:
+            return -1
+    return total
+
+
+class ReplicaRouter:
+    """Replica selection for the search fan-out.
+
+    The discovery pass publishes a ``dataset -> (replica urls)`` table
+    here (only fingerprint-identical copies are grouped); ``pick``
+    chooses among the live replicas by power-of-two-choices over the
+    recent per-worker RTT record (the selection-granularity mirror of
+    the transport's ``transport.rtt_ms`` histogram): sample two, take
+    the faster, skip breaker-open routes. One slow or dead host then
+    stops attracting traffic without any health-check protocol — the
+    RTTs the scatter already measures are the health signal.
+    """
+
+    #: recent round-trips kept per replica for the p2c comparison and
+    #: the adaptive hedge delay
+    RTT_WINDOW = 128
+    #: adaptive hedging needs this many completed calls before the p95
+    #: means anything; until then no hedge fires
+    HEDGE_MIN_SAMPLES = 8
+    #: adaptive hedge delay never drops below this (a sub-ms p95 would
+    #: hedge every call and double fleet load for nothing)
+    HEDGE_FLOOR_S = 0.05
+
+    def __init__(self, breaker: CircuitBreaker, *, rng=None):
+        self.breaker = breaker
+        # seeded: routing spread is reproducible under test
+        self._rng = rng or random.Random(0xBEAC0)
+        self._lock = threading.Lock()
+        self._table: dict[str, tuple[str, ...]] = {}
+        self._rtts: dict[str, collections.deque] = {}
+
+    # -- table --------------------------------------------------------------
+
+    def publish(self, table: dict[str, tuple[str, ...]]) -> None:
+        with self._lock:
+            self._table = {ds: tuple(urls) for ds, urls in table.items()}
+
+    def table(self) -> dict[str, tuple[str, ...]]:
+        with self._lock:
+            return dict(self._table)
+
+    def replicas(self, dataset: str) -> tuple[str, ...]:
+        with self._lock:
+            return self._table.get(dataset, ())
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return sum(len(urls) for urls in self._table.values())
+
+    # -- RTT record ---------------------------------------------------------
+
+    def note_rtt(self, url: str, seconds: float) -> None:
+        with self._lock:
+            ring = self._rtts.get(url)
+            if ring is None:
+                ring = self._rtts[url] = collections.deque(
+                    maxlen=self.RTT_WINDOW
+                )
+            ring.append(seconds)
+
+    def _rtt(self, url: str) -> float | None:
+        """Median recent RTT, or None for an unmeasured replica (treated
+        as fast, so fresh replicas get explored instead of starved)."""
+        with self._lock:
+            ring = self._rtts.get(url)
+            if not ring:
+                return None
+            s = sorted(ring)
+        return s[len(s) // 2]
+
+    def hedge_delay(self, hedge_delay_s: float | None) -> float | None:
+        """Seconds to wait before racing a second replica, with the
+        scan-pool semantics unchanged: >0 fixed, 0 adaptive (p95 of
+        recent RTTs once enough samples exist), <0/None off."""
+        d = hedge_delay_s
+        if d is None or d < 0:
+            return None
+        if d > 0:
+            return d
+        with self._lock:
+            all_rtts = [v for ring in self._rtts.values() for v in ring]
+        if len(all_rtts) < self.HEDGE_MIN_SAMPLES:
+            return None
+        all_rtts.sort()
+        return max(
+            all_rtts[int(0.95 * (len(all_rtts) - 1))], self.HEDGE_FLOOR_S
+        )
+
+    # -- selection ----------------------------------------------------------
+
+    def live(self, url: str) -> bool:
+        """Pure observation — never consumes a half-open probe (the
+        call-site ``allow`` gate does that once per attempted call)."""
+        return self.breaker.state(url) != OPEN
+
+    def pick(self, dataset: str, *, avoid=()) -> str | None:
+        """The replica to route ``dataset`` to, or None when every copy
+        is in ``avoid`` (failover exhausted the replica set)."""
+        cands = [u for u in self.replicas(dataset) if u not in avoid]
+        if not cands:
+            return None
+        # breaker-open routes are skipped while an alternative exists;
+        # with every copy open, route anyway — the call-site gate
+        # raises CircuitOpen cheaply and keeps the half-open probing
+        live = [u for u in cands if self.live(u)] or cands
+        if len(live) == 1:
+            return live[0]
+        a, b = self._rng.sample(live, 2)
+        ra = self._rtt(a) or 0.0
+        rb = self._rtt(b) or 0.0
+        return a if ra <= rb else b
 
 
 class ScanWorkerPool:
@@ -651,9 +886,23 @@ class DistributedEngine:
     optional local engine for locally-resident shards).
 
     Dataset routing is discovered from each worker's ``/datasets`` and
-    refreshed on demand; a dataset served by several workers goes to the
-    first (they are replicas of the same shard set).
+    refreshed on demand. A dataset served by several workers keeps its
+    FULL replica list (fingerprint-checked — only identical copies are
+    grouped): a :class:`ReplicaRouter` picks among live replicas by
+    power-of-two-choices over recent RTTs, ``search`` fails over to the
+    next replica when a worker errors or its circuit is open, and slow
+    primaries are hedged by a second replica after the hedge delay
+    (``transport.replica_hedge`` / ``hedge_delay_s``). When no replica
+    of a dataset is reachable the search degrades to partial results
+    (``resilience.partial_results``) instead of failing outright, and a
+    background rediscovery loop heals routes without a manual reload —
+    the fault tolerance the reference got for free from Lambda invoke
+    retries landing on a fresh instance.
     """
+
+    #: background rediscovery cadence once a route failure armed the
+    #: healing loop (it exits when every configured worker answers)
+    REDISCOVERY_INTERVAL_S = 2.0
 
     def __init__(
         self,
@@ -734,8 +983,25 @@ class DistributedEngine:
             half_open_probes=getattr(res, "breaker_half_open_probes", 1),
         )
         self._routes_lock = threading.Lock()
-        self._routes: dict[str, str] | None = None  # dataset -> worker url
+        self._discovered = False  # a discovery pass has published
         self._fingerprints: dict[str, str] = {}
+        # per-worker last-known /datasets contribution + who answered
+        # the most recent pass (the rediscovery loop's healed signal —
+        # retained fingerprints must not masquerade as reachability)
+        self._last_seen: dict[str, list[tuple[str, str]]] = {}
+        self._reachable: set[str] = set()
+        self._retention_warned: set[str] = set()
+        # replica selection (p2c over RTTs, breaker-aware) owns the
+        # dataset -> replica-urls table; every /search routing decision
+        # goes through router.pick — never by indexing a routes dict
+        # (tools/check_transport_usage.py enforces that statically)
+        self.router = ReplicaRouter(self.breaker)
+        self._failovers = 0
+        self._partials = 0
+        self._rediscoveries = 0
+        self._closed = threading.Event()
+        self._rediscover_thread: threading.Thread | None = None
+        self._hedge_exec: ThreadPoolExecutor | None = None
         # persistent scatter pool (no per-search thread churn)
         self._pool = ThreadPoolExecutor(
             max_workers=max_threads, thread_name_prefix="dispatch"
@@ -784,7 +1050,7 @@ class DistributedEngine:
         one is wired."""
         register_breaker_metrics(registry, lambda: self.breaker)
         register_transport_metrics(registry)
-        register_dispatch_metrics(registry, lambda: self._short_circuits)
+        register_dispatch_metrics(registry, self.dispatch_stats)
         reg = getattr(self.local, "register_metrics", None)
         if reg is not None:
             reg(registry)
@@ -795,11 +1061,45 @@ class DistributedEngine:
         with self._sc_lock:
             return self._short_circuits
 
+    def dispatch_stats(self) -> dict:
+        """The fan-out counters behind the ``dispatch.*`` / ``routing.*``
+        series (register_dispatch_metrics reads through this so a
+        swapped engine stays observable)."""
+        with self._sc_lock:
+            return {
+                "short_circuits": self._short_circuits,
+                "failovers": self._failovers,
+                "partial_responses": self._partials,
+                "rediscoveries": self._rediscoveries,
+                "replicas": self.router.replica_count(),
+            }
+
+    def unavailable_datasets(self) -> list[str]:
+        """Datasets in the route table with no live replica (every
+        copy's circuit open) — served as partial results until the
+        background rediscovery heals a route. Local state only
+        (breaker observation), so ``/ready`` can report it without a
+        worker round-trip."""
+        return sorted(
+            ds
+            for ds, urls in self.router.table().items()
+            if urls and not any(self.router.live(u) for u in urls)
+        )
+
     def close(self) -> None:
-        """Release the scatter pool and the pooled worker connections
-        (engines are long-lived; call this when rebuilding one on
-        config/route changes)."""
+        """Release the scatter/hedge pools, stop the rediscovery loop,
+        and drop the pooled worker connections (engines are long-lived;
+        call this when rebuilding one on config/route changes)."""
+        self._closed.set()
         self._pool.shutdown(wait=False, cancel_futures=True)
+        # under _sc_lock, paired with _hedge_pool's closed check: a
+        # hedge executor created concurrently with close() must not
+        # escape shutdown (its non-daemon threads would outlive the
+        # engine and stall interpreter exit)
+        with self._sc_lock:
+            hedge, self._hedge_exec = self._hedge_exec, None
+        if hedge is not None:
+            hedge.shutdown(wait=False, cancel_futures=True)
         if self._owns_transport and self.transport is not None:
             self.transport.close()
 
@@ -811,8 +1111,35 @@ class DistributedEngine:
 
     # -- discovery ----------------------------------------------------------
 
-    def _discover(self) -> dict[str, str]:
-        routes: dict[str, str] = {}
+    @staticmethod
+    def _group_replicas(ds: str, entries: list[tuple[str, str]]) -> tuple:
+        """The replica urls for one dataset, grouped by per-dataset
+        fingerprint: only identical shard copies are interchangeable.
+        On a mismatch the newest copy wins (row-count freshness,
+        :func:`_fingerprint_freshness`) and the stale workers are
+        excluded from this dataset's routes — failover to a divergent
+        copy would silently change the answer mid-request."""
+        by_fp: dict[str, list[str]] = {}
+        for url, fp in entries:
+            by_fp.setdefault(fp, []).append(url)
+        if len(by_fp) == 1:
+            return tuple(next(iter(by_fp.values())))
+        win = max(by_fp, key=lambda fp: (_fingerprint_freshness(fp), fp))
+        losers = sorted(
+            u for fp, urls in by_fp.items() if fp != win for u in urls
+        )
+        log.warning(
+            "dataset %s: divergent index copies across workers — routing "
+            "to the newest copy on %s, excluding stale %s (re-ingest or "
+            "POST /reload the excluded workers)",
+            ds,
+            sorted(by_fp[win]),
+            losers,
+        )
+        return tuple(by_fp[win])
+
+    def _discover(self) -> dict[str, tuple[str, ...]]:
+        found: dict[str, list[tuple[str, str]]] = {}  # url -> [(ds, fp)]
         fps: dict[str, str] = {}
         for url in self.worker_urls:
             try:
@@ -845,19 +1172,127 @@ class DistributedEngine:
             if status != 200:
                 continue
             fps[url] = doc.get("fingerprint", "")
-            for ds in doc.get("datasets", []):
-                routes.setdefault(ds, url)
+            # answering discovery REVIVES an open/half-open route (the
+            # rediscovery loop's whole point; like reload_workers'
+            # answered -> record_success revival) — but must NOT touch
+            # a CLOSED circuit's failure count: /datasets answering
+            # says nothing about /search health, and resetting the
+            # count every pass would keep a search-broken worker's
+            # breaker from ever opening
+            if self.breaker.state(url) != CLOSED:
+                self.breaker.record_success(url)
+            ds_fps = doc.get("dataset_fingerprints") or {}
+            found[url] = [
+                (ds, str(ds_fps.get(ds, fps[url])))
+                for ds in doc.get("datasets", [])
+            ]
         with self._routes_lock:
-            self._routes = routes
-            self._fingerprints = fps
-        return routes
+            # per-worker retention: a worker that ANSWERED owns its
+            # route contribution outright (dropping a dataset it no
+            # longer advertises is correct); a worker that did NOT
+            # answer keeps its last-known-good contribution — a
+            # partially-successful pass must not silently vanish a
+            # dead worker's datasets from the table (they must keep
+            # degrading to marked partial results, not to unmarked
+            # empty answers)
+            merged: dict[str, list[tuple[str, str]]] = {}
+            for url in self.worker_urls:
+                per = found.get(url)
+                if per is None:
+                    per = self._last_seen.get(url, [])
+                    # warn ONCE per outage, not once per rediscovery
+                    # pass (a decommissioned URL left in worker_urls
+                    # would otherwise spam this line forever)
+                    if per and url not in self._retention_warned:
+                        self._retention_warned.add(url)
+                        log.warning(
+                            "worker %s unreachable during discovery; "
+                            "keeping its last-known-good routes "
+                            "(%d dataset(s), may be stale) until it "
+                            "answers",
+                            url,
+                            len(per),
+                        )
+                else:
+                    self._retention_warned.discard(url)
+                for ds, fp in per:
+                    merged.setdefault(ds, []).append((url, fp))
+            table = {
+                ds: self._group_replicas(ds, entries)
+                for ds, entries in merged.items()
+            }
+            self._discovered = True
+            self._last_seen.update(found)
+            self._reachable = set(found)
+            # last-known fingerprints are retained for unreachable
+            # workers too: the aggregate index identity (cache keys)
+            # must not flap with reachability
+            self._fingerprints.update(fps)
+            self.router.publish(table)
+        return table
+
+    def replica_table(
+        self, refresh: bool = False
+    ) -> dict[str, tuple[str, ...]]:
+        """dataset -> replica urls, discovering on first use."""
+        with self._routes_lock:
+            discovered = self._discovered
+        if not discovered or refresh:
+            return self._discover()
+        return self.router.table()
 
     def routes(self, refresh: bool = False) -> dict[str, str]:
+        """dataset -> primary worker url (back-compat view of the
+        replica table; routing decisions go through the router)."""
+        return {
+            ds: urls[0]
+            for ds, urls in self.replica_table(refresh).items()
+            if urls
+        }
+
+    # -- background rediscovery --------------------------------------------
+
+    def _nudge_rediscovery(self) -> None:
+        """Arm the healing loop (worker failure / breaker-open saw a
+        dead route): one daemon thread re-runs discovery until every
+        configured worker answers again, so routes heal without a
+        manual reload_workers. Idempotent while a loop is running."""
+        if self._closed.is_set():
+            return
         with self._routes_lock:
-            cached = self._routes
-        if cached is None or refresh:
-            return self._discover()
-        return cached
+            t = self._rediscover_thread
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(
+                target=self._rediscover_loop,
+                daemon=True,
+                name="dispatch-rediscovery",
+            )
+            self._rediscover_thread = t
+        t.start()
+
+    def _rediscover_loop(self) -> None:
+        delay = self.REDISCOVERY_INTERVAL_S
+        while not self._closed.wait(delay):
+            # a permanently-gone worker (decommissioned URL still in
+            # worker_urls) must not spin full-rate discovery forever:
+            # back off toward a slow steady probe
+            delay = min(delay * 2, max(30.0, self.REDISCOVERY_INTERVAL_S))
+            try:
+                self._discover()
+            except Exception:
+                log.exception("route rediscovery pass failed")
+            with self._sc_lock:
+                self._rediscoveries += 1
+            with self._routes_lock:
+                # healed = every configured worker ANSWERED the latest
+                # pass (not merely has a retained fingerprint from
+                # before it died)
+                healed = all(
+                    url in self._reachable for url in self.worker_urls
+                )
+            if healed:
+                return
 
     def datasets(self) -> list[str]:
         out = set(self.routes())
@@ -894,8 +1329,11 @@ class DistributedEngine:
     ):
         if not self.breaker.allow(url):
             # fast-fail: the route failed repeatedly and its reset
-            # window hasn't lapsed — don't spend timeout_s finding out
+            # window hasn't lapsed — don't spend timeout_s finding out.
+            # An open route also arms the background rediscovery loop
+            # (the worker may have restarted with fresh shards).
             annotate(breaker="open")
+            self._nudge_rediscovery()
             raise CircuitOpen(f"worker {url}: circuit open")
         # serialize ONCE: the pooled transport ships these bytes
         # verbatim (the old path built a dict just for the transport to
@@ -915,6 +1353,7 @@ class DistributedEngine:
             timeout_s = deadline.clamp(self.timeout_s)
             if timeout_s is not None and timeout_s <= 0:
                 deadline.check(f"worker {url} call")
+            t0 = time.perf_counter()
             try:
                 fault_point("worker.http", url)
                 status, out = self._post_auth(
@@ -924,6 +1363,11 @@ class DistributedEngine:
                 last = WorkerError(f"{url}: {e}")
             else:
                 if status == 200:
+                    # successful RTTs feed the router's p2c comparison
+                    # and the adaptive replica-hedge delay
+                    self.router.note_rtt(
+                        url, time.perf_counter() - t0
+                    )
                     self.breaker.record_success(url)
                     return [
                         VariantSearchResponse(**r)
@@ -943,39 +1387,195 @@ class DistributedEngine:
                 f"worker {url}: request deadline expired"
             ) from last
         self.breaker.record_failure(url)
+        self._nudge_rediscovery()
         raise last
+
+    # -- replica hedging + failover ----------------------------------------
+
+    def _hedge_pool(self) -> ThreadPoolExecutor:
+        with self._sc_lock:
+            if self._hedge_exec is None:
+                if self._closed.is_set():
+                    # a leg draining through close() must not create an
+                    # executor nothing will ever shut down
+                    raise WorkerError("engine closed")
+                # every multi-replica leg's PRIMARY rides this pool
+                # when hedging is armed, so it must never cap fan-out
+                # below the scatter pool: size for max_threads
+                # primaries plus their hedges (threads spawn lazily —
+                # idle fleets never pay for the ceiling). The
+                # started-event gate below still stops a queued
+                # primary from triggering load-doubling hedges if the
+                # pool somehow saturates.
+                self._hedge_exec = ThreadPoolExecutor(
+                    max_workers=max(8, 2 * self.max_threads),
+                    thread_name_prefix="dispatch-hedge",
+                )
+            return self._hedge_exec
+
+    def _hedge_candidate(
+        self, ds_list: list[str], avoid: set[str]
+    ) -> str | None:
+        """A live replica (other than ``avoid``) serving EVERY dataset
+        in the group, fastest-first, or None when the group has no
+        common alternative (single-replica fleets never hedge)."""
+        common: set[str] | None = None
+        for ds in ds_list:
+            urls = set(self.router.replicas(ds))
+            common = urls if common is None else common & urls
+        cands = sorted((common or set()) - avoid)
+        live = [u for u in cands if self.router.live(u)]
+        if not live:
+            return None
+        return min(live, key=lambda u: self.router._rtt(u) or 0.0)
+
+    def _call_replicas(
+        self, url: str, payload: VariantQueryPayload, deadline, tried: set
+    ) -> list[VariantSearchResponse]:
+        """One replica-hedged /search leg (Dean & Barroso promoted from
+        scan slices to full searches): the primary runs on the hedge
+        pool; if it has not answered within the hedge delay, the same
+        sub-query races on a second replica and the first success wins.
+        /search is an idempotent read, so the loser's duplicate
+        execution only costs its CPU — the hedge still only fires once
+        the primary actually STARTED (a primary queued behind a full
+        pool must not trigger load-doubling hedges), mirroring the
+        transport's started/not-started replay discipline. A hedge
+        target that also failed is added to ``tried`` so failover does
+        not re-try it."""
+        delay = None
+        if getattr(self.transport_config, "replica_hedge", True):
+            delay = self.router.hedge_delay(
+                getattr(self.transport_config, "hedge_delay_s", 0.0)
+            )
+        other = (
+            self._hedge_candidate(payload.dataset_ids or [], {url} | tried)
+            if delay is not None
+            else None
+        )
+        if delay is None or other is None:
+            return self._call_worker_traced(url, payload, deadline)
+        pool = self._hedge_pool()
+        ctx = current_context()
+        started = threading.Event()
+
+        def primary():
+            started.set()
+            return self._call_worker(url, payload, deadline, ctx)
+
+        futs = {pool.submit(primary): url}
+        done, _pending = futures_mod.wait(futs, timeout=delay)
+        if not done and started.is_set():
+            note_hedge()  # process-wide transport.hedges counter
+            annotate(replica_hedge=True)
+            futs[
+                pool.submit(self._call_worker, other, payload, deadline, ctx)
+            ] = other
+        pending = set(futs)
+        last: Exception | None = None
+        while pending:
+            done, pending = futures_mod.wait(
+                pending, return_when=futures_mod.FIRST_COMPLETED
+            )
+            for f in done:
+                u = futs[f]
+                try:
+                    out = f.result()
+                except Exception as e:
+                    last = e
+                    if u != url:
+                        tried.add(u)
+                    continue
+                return out
+        raise last
+
+    def _search_group(
+        self, url, ds_list, payload: VariantQueryPayload, deadline, ctx
+    ):
+        # like _call_worker: the request context rides in explicitly
+        # (pool thread) so trace headers and outcome notes keep working
+        with request_context(ctx if ctx is not None else current_context()):
+            return self._search_group_traced(url, ds_list, payload, deadline)
+
+    def _search_group_traced(
+        self, url: str, ds_list: list[str], payload, deadline
+    ) -> tuple[list[VariantSearchResponse], list[str], Exception | None]:
+        """One scatter leg with automatic failover: the group's primary
+        is tried first (hedged); on a worker error or open circuit each
+        dataset re-routes to its next untried replica — never the same
+        copy twice — until ``resilience.failover_retries`` extra
+        replicas have been spent or the replica set is exhausted.
+        Returns ``(responses, failed_datasets, first_error)``; only a
+        deadline expiry raises (no time left to fail over)."""
+        res = getattr(self.config, "resilience", None)
+        max_extra = getattr(res, "failover_retries", 2)
+        responses: list[VariantSearchResponse] = []
+        failed: list[str] = []
+        first_err: Exception | None = None
+        work = [(url, list(ds_list), {url})]
+        while work:
+            u, dss, tried = work.pop()
+            sub = dataclasses.replace(payload, dataset_ids=dss)
+            try:
+                responses.extend(
+                    self._call_replicas(u, sub, deadline, tried)
+                )
+                continue
+            except DeadlineExceeded:
+                raise  # the request is out of time — no failover
+            except (WorkerError, CircuitOpen) as e:
+                if first_err is None:
+                    first_err = e
+            if len(tried) > max_extra:
+                # primary + max_extra replicas all failed: give these
+                # datasets up to the partial-results path
+                failed.extend(dss)
+                continue
+            regroup: dict[str, list[str]] = {}
+            for ds in dss:
+                nxt = self.router.pick(ds, avoid=tried)
+                if nxt is None:
+                    failed.append(ds)
+                else:
+                    regroup.setdefault(nxt, []).append(ds)
+            for nu, nds in sorted(regroup.items()):
+                with self._sc_lock:
+                    self._failovers += 1
+                annotate(failover=True)
+                work.append((nu, nds, tried | {nu}))
+        return responses, failed, first_err
 
     def search(
         self, payload: VariantQueryPayload
     ) -> list[VariantSearchResponse]:
         with span("dispatch.search") as sp:
             current_deadline().check("dispatch.search")
-            routes = self.routes()
+            table = self.replica_table()
             wanted = payload.dataset_ids or self.datasets()
             local_ds = (
                 set(self.local.datasets()) if self.local is not None else set()
             )
-            if any(ds not in local_ds and ds not in routes for ds in wanted):
+            if any(ds not in local_ds and ds not in table for ds in wanted):
                 # an explicitly requested dataset may have been ingested
                 # after the last discovery: refresh once before treating
                 # it as unknown (a stale skip would be indistinguishable
                 # from 'no variants found')
-                routes = self.routes(refresh=True)
+                table = self.replica_table(refresh=True)
             by_worker: dict[str, list[str]] = {}
             local_wanted: list[str] = []
             for ds in wanted:
                 if ds in local_ds:
                     local_wanted.append(ds)
-                elif ds in routes:
-                    by_worker.setdefault(routes[ds], []).append(ds)
+                elif ds in table:
+                    # p2c primary pick; failover inside the group leg
+                    # walks the remaining replicas
+                    primary = self.router.pick(ds)
+                    if primary is not None:
+                        by_worker.setdefault(primary, []).append(ds)
                 # still-unknown datasets are skipped, like unmatched
                 # chromosomes (get_matching_chromosome filter)
 
-            tasks = []
-            for url, ds_list in sorted(by_worker.items()):
-                tasks.append(
-                    (url, dataclasses.replace(payload, dataset_ids=ds_list))
-                )
+            tasks = sorted(by_worker.items())
             # a boolean-granularity fan-out with no resultset detail
             # requested is a logical OR: the first hit anywhere decides
             # the answer, so the rest of the scatter is abandoned.
@@ -992,13 +1592,18 @@ class DistributedEngine:
             )
             short_circuited = False
             responses: list[VariantSearchResponse] = []
+            unavailable: list[str] = []
+            group_err: Exception | None = None
             deadline = current_deadline()
             futures: dict = {}
             if tasks:
                 ctx = current_context()
                 futures = {
-                    self._pool.submit(self._call_worker, *t, deadline, ctx): t[0]
-                    for t in tasks
+                    self._pool.submit(
+                        self._search_group, url, ds_list, payload,
+                        deadline, ctx,
+                    ): url
+                    for url, ds_list in tasks
                 }
             # the LOCAL shard search runs on this thread CONCURRENTLY
             # with the worker fan-out (it used to wait for the full
@@ -1049,7 +1654,7 @@ class DistributedEngine:
                         break
                     for f in done:
                         try:
-                            out = f.result()
+                            out, failed, gerr = f.result()
                         except (
                             Exception,
                             futures_mod.CancelledError,
@@ -1060,6 +1665,13 @@ class DistributedEngine:
                                 first_err = e
                         else:
                             responses.extend(out)
+                            if failed:
+                                # this group exhausted its replicas for
+                                # these datasets: candidate for partial
+                                # results, not an immediate failure
+                                unavailable.extend(failed)
+                                if group_err is None:
+                                    group_err = gerr
                             if short_circuit_ok and any(
                                 r.exists for r in out
                             ):
@@ -1081,12 +1693,40 @@ class DistributedEngine:
                         self._short_circuits += 1
                     annotate(short_circuit=True)
             elif first_err is not None:
+                # a local-engine error, deadline expiry, or cancelled
+                # drain is a real failure — partial results only cover
+                # unreachable replicas
                 raise first_err
+            elif unavailable:
+                unavailable = sorted(set(unavailable))
+                self._nudge_rediscovery()
+                if not getattr(
+                    getattr(self.config, "resilience", None),
+                    "partial_results",
+                    True,
+                ):
+                    raise group_err or WorkerError(
+                        "no reachable replica for dataset(s): "
+                        + ", ".join(unavailable)
+                    )
+                # graceful degradation: answer with the datasets that
+                # responded and mark the unreachable ones — the API
+                # layer stamps meta.unavailableDatasets + a warning
+                # instead of turning one dead fleet corner into a 502
+                with self._sc_lock:
+                    self._partials += 1
+                annotate(unavailable_datasets=tuple(unavailable))
+                log.warning(
+                    "partial results: no reachable replica for %s (%s)",
+                    unavailable,
+                    group_err,
+                )
             responses.sort(key=lambda r: (r.dataset_id, r.vcf_location))
             sp.note(
                 workers=len(tasks),
                 responses=len(responses),
                 short_circuit=short_circuited,
+                unavailable=len(unavailable),
             )
         return responses
 

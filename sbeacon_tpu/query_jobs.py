@@ -437,6 +437,10 @@ class AsyncQueryRunner:
 
     #: seconds between opportunistic TTL sweeps piggybacked on submit()
     PURGE_INTERVAL_S = 60.0
+    #: in-memory lifetime of a PARTIAL (replicas-down, degraded) result:
+    #: long enough to hand to the waiters coalesced onto the job, far
+    #: too short to serve as a cached answer after the routes heal
+    PARTIAL_HANDOFF_TTL_S = 5.0
 
     def __init__(
         self,
@@ -629,10 +633,34 @@ class AsyncQueryRunner:
                 try:
                     with deadline_scope(job_deadline):
                         responses = self.engine.search(pl)
+                    # a DEGRADED answer (some datasets had no reachable
+                    # replica — dispatch annotated unavailable_datasets
+                    # on the request context) must not be cached as THE
+                    # answer for the query TTL: it is handed to the
+                    # waiters coalesced onto this job, then the job is
+                    # dropped so later identical queries re-execute
+                    # against the (possibly healed) routes instead of
+                    # replaying a stale empty result
+                    unavailable = tuple(
+                        job_ctx.notes.get("unavailable_datasets") or ()
+                        if job_ctx is not None
+                        else ()
+                    )
+                    partial = bool(unavailable)
+                    ttl = (
+                        self.PARTIAL_HANDOFF_TTL_S
+                        if partial
+                        else self.table.query_ttl_s
+                    )
+                    # the unavailable set rides WITH the cached handoff:
+                    # a coalesced waiter (different request context)
+                    # must get the partial marking too, not a silently
+                    # incomplete answer
                     with self._lock:
                         self._results[query_id] = (
                             responses,
-                            time.time() + self.table.query_ttl_s,
+                            time.time() + ttl,
+                            unavailable,
                         )
                     # waiters are served from the in-memory handoff the
                     # moment the search finishes; the sqlite persistence
@@ -641,12 +669,19 @@ class AsyncQueryRunner:
                     # (a WAL checkpoint fsync here was a >1 s soak-tail
                     # outlier with the kernels fully warm)
                     done.set()
-                    for resp in responses:
-                        n = self.table.next_response_number(query_id, claim)
-                        if n:
-                            self.table.put_response(query_id, n, resp, claim)
-                    self.table.mark_finished(query_id, claim)
-                    self.table.complete(query_id, claim)
+                    if partial:
+                        self.table.abandon(query_id, claim)
+                    else:
+                        for resp in responses:
+                            n = self.table.next_response_number(
+                                query_id, claim
+                            )
+                            if n:
+                                self.table.put_response(
+                                    query_id, n, resp, claim
+                                )
+                        self.table.mark_finished(query_id, claim)
+                        self.table.complete(query_id, claim)
                 except Exception:
                     # never cache a failure as an empty result: drop the
                     # job so pollers fall back to a direct search (which
@@ -687,10 +722,16 @@ class AsyncQueryRunner:
             wait_s = current_deadline().clamp(wait_s)
             with self._lock:
                 ev = self._done.get(query_id)
+                handed_off = query_id in self._results
             if ev is not None:
                 # in-process job: block on its completion event (no poll)
                 ev.wait(wait_s)
-            elif not self.table.wait(query_id, timeout_s=wait_s):
+            elif not handed_off and not self.table.wait(
+                query_id, timeout_s=wait_s
+            ):
+                # no in-memory handoff either; the table never
+                # completed (a PARTIAL job is abandoned there by
+                # design, so the handoff check must come first)
                 return None
         # in-memory handoff FIRST: for in-process jobs the results exist
         # the moment the search finishes, before (and regardless of) the
@@ -698,6 +739,11 @@ class AsyncQueryRunner:
         with self._lock:
             hit = self._results.get(query_id)
         if hit is not None and hit[1] > time.time():
+            if len(hit) > 2 and hit[2]:
+                # replay the partial marking onto THIS caller's request
+                # context — the job thread annotated the submitter's,
+                # and a coalesced waiter has its own
+                annotate(unavailable_datasets=hit[2])
             return hit[0]
         if self.table.get_job_status(query_id) is not JobStatus.COMPLETED:
             return None

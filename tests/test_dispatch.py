@@ -107,6 +107,10 @@ def test_worker_fingerprint_in_coordinator(cluster):
 
 
 def test_retry_then_error():
+    """Strict mode (partial_results=False): an unreachable sole worker
+    fails the query after the per-route retries, like before replicas."""
+    from sbeacon_tpu.config import ResilienceConfig
+
     calls = {"n": 0}
 
     def flaky_post(url, doc, timeout_s):
@@ -117,7 +121,13 @@ def test_retry_then_error():
         return 200, {"datasets": ["dsX"], "fingerprint": "f"}
 
     dist = DistributedEngine(
-        ["http://127.0.0.1:1"], retries=2, post=flaky_post, get=fake_get
+        ["http://127.0.0.1:1"],
+        retries=2,
+        post=flaky_post,
+        get=fake_get,
+        config=BeaconConfig(
+            resilience=ResilienceConfig(partial_results=False)
+        ),
     )
     import dataclasses
 
@@ -212,9 +222,12 @@ def test_app_ingest_targets_local_engine(tmp_path, cluster):
 
 def test_fast_failure_awaits_slow_siblings():
     """A fast-failing worker must not strand slow siblings' tasks in the
-    shared pool: search() awaits every future before raising."""
+    shared pool: search() awaits every future before raising (strict
+    mode — partial_results=False keeps the fail-the-query contract)."""
     import threading
     import time
+
+    from sbeacon_tpu.config import ResilienceConfig
 
     done = threading.Event()
 
@@ -230,7 +243,13 @@ def test_fast_failure_awaits_slow_siblings():
         return 200, {"datasets": [ds], "fingerprint": ds}
 
     dist = DistributedEngine(
-        ["http://fast:1", "http://slow:1"], retries=0, post=post, get=get
+        ["http://fast:1", "http://slow:1"],
+        retries=0,
+        post=post,
+        get=get,
+        config=BeaconConfig(
+            resilience=ResilienceConfig(partial_results=False)
+        ),
     )
     import dataclasses
 

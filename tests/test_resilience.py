@@ -621,6 +621,7 @@ def test_job_wait_clamped_by_ambient_deadline():
 
 
 def _dispatch_engine(post, clock, *, threshold=3, retries=0):
+    from sbeacon_tpu.config import BeaconConfig, ResilienceConfig
     from sbeacon_tpu.parallel.dispatch import DistributedEngine
 
     def get(url, timeout_s, headers=None):
@@ -632,8 +633,18 @@ def _dispatch_engine(post, clock, *, threshold=3, retries=0):
         half_open_probes=1,
         clock=clock,
     )
+    # strict mode: these tests assert the raise semantics of a
+    # single-replica fleet (partial-results degradation is covered by
+    # tests/test_replica_routing.py)
     return DistributedEngine(
-        ["http://w1:1"], retries=retries, post=post, get=get, breaker=br
+        ["http://w1:1"],
+        retries=retries,
+        post=post,
+        get=get,
+        breaker=br,
+        config=BeaconConfig(
+            resilience=ResilienceConfig(partial_results=False)
+        ),
     )
 
 
@@ -1150,6 +1161,11 @@ def test_chaos_soak_no_hung_threads(tmp_path):
         "query-runner",
         "query-jobs-purge",
         "kernel-launch",
+        # the batcher's fetcher pool grows lazily under load; its idle
+        # threads are reusable infrastructure like kernel-launch's
+        # (more chaos requests now SUCCEED via failover/partial
+        # results, so the pool reaches its full size mid-soak)
+        "kernel-fetch",
         "Thread-",
     )
     t_end = time.time() + 20

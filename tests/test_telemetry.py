@@ -174,6 +174,10 @@ GOLDEN_METRICS = [
     "transport.hedges",
     "transport.rtt_ms",
     "dispatch.short_circuits",
+    "dispatch.failovers",
+    "dispatch.partial_responses",
+    "routing.replicas",
+    "routing.rediscoveries",
     "breaker.state",
     "breaker.consecutive_failures",
     "breaker.opens",
