@@ -1080,6 +1080,15 @@ def config9_soak(shard, sindex):
         # count ride in every BENCH record via _TELEMETRY, so the
         # perf trajectory carries the decomposition, not just totals
         tj = app.telemetry.render_json()
+        # SLO snapshot + end-to-end queue-wait decomposition (ISSUE 7):
+        # every BENCH record carries the burn-rate state and the
+        # per-stage quantiles, so a perf regression names its stage AND
+        # its budget impact in the same line
+        slo_snap = app.slo.snapshot()
+        decomposition = {
+            "admission_wait_ms": app.query_runner.queue_wait_summary(),
+        }
+        decomposition.update(app.engine.stage_timing())
         _TELEMETRY.update(
             request_latency_ms=tj.get("request", {}).get("latency_ms", {}),
             slow_queries=tj.get("request", {}).get("slow_queries", 0),
@@ -1092,6 +1101,19 @@ def config9_soak(shard, sindex):
                     "launch_ms",
                     "fetch_ms",
                 )
+            },
+            queue_wait_decomposition=decomposition,
+            slo={
+                route: {
+                    "breached": doc["breached"],
+                    "availability_burn_5m": doc["availability"][
+                        "windows"
+                    ]["5m"]["burnRate"],
+                    "latency_burn_5m": doc["latency"]["windows"]["5m"][
+                        "burnRate"
+                    ],
+                }
+                for route, doc in slo_snap["routes"].items()
             },
         )
         # repeated-query (cache-hit) path: the fingerprint-keyed
@@ -1333,6 +1355,133 @@ def config10_fanout():
     return out
 
 
+def config11_slo():
+    """SLO burn-rate probe (ISSUE 7): a seeded kernel.launch fault plan
+    drives 5xx on the g_variants route and the record asserts the
+    burn-rate gauges MOVED — plus the flight-recorder event count and
+    the observability overhead on a clean warm path."""
+    import random as _random
+    import tempfile
+    from pathlib import Path
+
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig, StorageConfig
+    from sbeacon_tpu.harness import faults
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.telemetry import journal
+    from sbeacon_tpu.testing import random_records
+
+    rng = _random.Random(1100)
+    recs = random_records(rng, chrom="1", n=3000, n_samples=2)
+    with tempfile.TemporaryDirectory(prefix="bench-slo-") as td:
+        cfg = BeaconConfig(
+            storage=StorageConfig(root=Path(td)),
+            engine=EngineConfig(
+                use_mesh=False,
+                microbatch=True,
+                device_planes=False,
+                response_cache=False,  # every query must reach a launch
+            ),
+        )
+        cfg.storage.ensure()
+        app = BeaconApp(cfg)
+        app.engine.add_index(
+            build_index(
+                recs,
+                dataset_id="slo0",
+                vcf_location="slo0.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+        app.store.upsert(
+            "datasets",
+            [
+                {
+                    "id": "slo0",
+                    "name": "slo0",
+                    "_assemblyId": "GRCh38",
+                    "_vcfLocations": ["synthetic://slo0"],
+                }
+            ],
+        )
+        app.engine.warmup()
+        pos = [int(r.pos) for r in recs]
+
+        def query(k: int):
+            # distinct coordinates per call: the async job table must
+            # not coalesce the sequence into one execution
+            p = pos[k % len(pos)]
+            return {
+                "query": {
+                    "requestedGranularity": "boolean",
+                    "requestParameters": {
+                        "assemblyId": "GRCh38",
+                        "referenceName": "1",
+                        "start": [max(0, p - 1)],
+                        "end": [p + 1 + (k % 7)],
+                        "alternateBases": "N",
+                    },
+                }
+            }
+
+        try:
+            seq0 = journal.last_seq()
+            # clean warm traffic first: burn must be zero
+            for k in range(20):
+                app.handle("POST", "/g_variants", body=query(k))
+            _, slo_before = app.handle("GET", "/slo")
+            gv = slo_before["routes"]["g_variants"]["availability"]
+            burn_before = gv["windows"]["5m"]["burnRate"]
+            # seeded fault plan: half the kernel launches raise
+            faults.install(
+                {
+                    "seed": 11,
+                    "rules": [
+                        {
+                            "site": "kernel.launch",
+                            "kind": "error",
+                            "rate": 0.5,
+                        }
+                    ],
+                }
+            )
+            n_5xx = 0
+            try:
+                for k in range(20, 60):
+                    status, _b = app.handle(
+                        "POST", "/g_variants", body=query(k)
+                    )
+                    if status >= 500:
+                        n_5xx += 1
+            finally:
+                faults.uninstall()
+            _, slo_after = app.handle("GET", "/slo")
+            gv = slo_after["routes"]["g_variants"]["availability"]
+            burn_after = gv["windows"]["5m"]["burnRate"]
+            _, dbg = app.handle("GET", "/debug/status")
+            return {
+                "queries": 60,
+                "errors_5xx": n_5xx,
+                "burn_rate_5m_before": burn_before,
+                "burn_rate_5m_after": burn_after,
+                "burn_rate_1h_after": gv["windows"]["1h"]["burnRate"],
+                "gauges_moved": bool(
+                    burn_after > burn_before and n_5xx > 0
+                ),
+                "breached": slo_after["routes"]["g_variants"]["breached"],
+                # kernel-level faults are data-plane failures: the
+                # recorder stays quiet unless a breaker/route actually
+                # transitioned — zero here is the honest answer
+                "control_plane_events": len(
+                    journal.events(since=seq0, limit=1024)
+                ),
+                "journal_total_published": journal.published(),
+                "slowest_stage": dbg["diagnosis"]["slowestStage"],
+            }
+        finally:
+            app.close()
+
+
 _COLOCATED_SOAK_PROBE = """
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -1512,6 +1661,7 @@ def main() -> None:
     run("config8_skew", 80, config8_skew)
     run("config9_soak", 120, lambda: config9_soak(shard, sindex))
     run("config10_fanout", 60, config10_fanout)
+    run("config11_slo", 40, config11_slo)
     emit(final=True)
 
 

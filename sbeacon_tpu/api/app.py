@@ -40,10 +40,12 @@ from ..resilience import (
     register_admission_metrics,
     register_breaker_metrics,
 )
+from ..slo import SloEngine
 from ..telemetry import (
     MetricsRegistry,
     RequestContext,
     SlowQueryLog,
+    journal,
     profiler,
     request_context,
     sanitize_trace_id,
@@ -225,6 +227,18 @@ class BeaconApp:
         self.slow_log = SlowQueryLog(
             threshold_ms=obs.slow_query_ms, path=obs.slow_query_log
         )
+        # SLO engine (slo.py): per-route availability + latency
+        # objectives evaluated as 5m/1h burn rates over every request
+        # outcome; served at /slo and as slo.* gauges
+        self.slo = SloEngine.from_config(obs)
+        # flight recorder: the process journal was built from env
+        # defaults at import; the config tier re-applies here (like
+        # profiler.directory) so BEACON_EVENT_JOURNAL_* and explicit
+        # ObservabilityConfig fields agree
+        journal.configure(
+            keep=getattr(obs, "event_journal_size", 1024),
+            enabled=getattr(obs, "event_journal", True),
+        )
         if obs.profile_dir:
             # config-armed profiling (the env var SBEACON_PROFILE sets
             # the same field at import); first profiled region starts
@@ -270,16 +284,25 @@ class BeaconApp:
         registry. Suppliers read through ``self`` so components swapped
         at runtime (tests replace ``app.admission``) stay observable."""
         reg = self.telemetry
-        # request-level series owned by the app itself
+        # request-level series owned by the app itself; exemplars link
+        # each latency bucket to the trace id of its latest request, so
+        # a slow bucket resolves at /_trace?trace_id=...
         self._req_latency = reg.histogram(
             "request.latency_ms",
             "end-to-end request latency per route",
             label="route",
+            exemplars=True,
         )
         reg.counter(
             "request.slow_queries",
             "requests recorded by the slow-query log",
             fn=lambda: self.slow_log.count(),
+        )
+        self.slo.register_metrics(reg)
+        reg.counter(
+            "events.published",
+            "control-plane events published to the flight recorder",
+            fn=journal.published,
         )
         register_admission_metrics(reg, lambda: self.admission)
         self.query_runner.register_metrics(reg)
@@ -320,6 +343,9 @@ class BeaconApp:
         "health",
         "ready",
         "metrics",
+        "slo",
+        "ops",
+        "debug",
         "_trace",
     }
 
@@ -332,6 +358,12 @@ class BeaconApp:
             return "other"
         if len(parts) == 1:
             return head
+        if head in ("ops", "debug"):
+            # diagnostic surfaces: only the KNOWN two-segment paths get
+            # named labels — /ops/<anything-else> must collapse like
+            # any other unknown path or a scanner mints series
+            label = f"{head}.{parts[1]}"
+            return label if label in ("ops.events", "debug.status") else "other"
         sub = parts[-1]
         if sub in ("filtering_terms", "g_variants", "biosamples",
                    "individuals", "runs", "analyses"):
@@ -365,7 +397,12 @@ class BeaconApp:
                 method, path, query_params, body, headers
             )
         elapsed_ms = (time.perf_counter() - t0) * 1e3
-        self._req_latency.observe(elapsed_ms, label_value=route)
+        # the exemplar is passed explicitly: this runs OUTSIDE the
+        # request_context scope, so the ambient lookup would miss
+        self._req_latency.observe(
+            elapsed_ms, label_value=route, exemplar=ctx.trace_id
+        )
+        self.slo.record(route, status, elapsed_ms)
         self.slow_log.maybe_record(
             trace_id=ctx.trace_id,
             route=route,
@@ -402,10 +439,15 @@ class BeaconApp:
                     "health",
                     "ready",
                     "metrics",
+                    "slo",
+                    "ops/events",
+                    "debug/status",
                 ):
-                    # probes/metrics bypass auth, admission AND
-                    # deadlines: they must answer while the server is
-                    # saturated or shedding — that is their whole job
+                    # probes/metrics AND the self-diagnosis surfaces
+                    # bypass auth, admission and deadlines: a flight
+                    # recorder that stops answering exactly when the
+                    # server is saturated or shedding is useless —
+                    # answering then is their whole job
                     return self._probe(head, query_params, headers)
                 denied = self._check_auth(method.upper(), path, headers)
                 if denied is not None:
@@ -484,15 +526,135 @@ class BeaconApp:
             if degraded is not None:
                 body["degradedDatasets"] = degraded()
             return (200 if self.ready else 503), body
-        # /metrics: content negotiation — ?format=prometheus or
-        # ``Accept: text/plain`` gets the exposition text (the transport
-        # serves str payloads as text/plain), everything else the
-        # back-compat nested JSON
+        if head == "slo":
+            # per-route objectives + multi-window burn rates (the JSON
+            # twin of the slo.* Prometheus gauges)
+            return 200, self.slo.snapshot()
+        if head == "ops/events":
+            return self._ops_events(query_params)
+        if head == "debug/status":
+            return 200, self._debug_status()
+        # /metrics: content negotiation — ?format=openmetrics or an
+        # ``Accept: application/openmetrics-text`` (what a modern
+        # Prometheus scrape sends first) gets the OpenMetrics dialect
+        # WITH exemplar annotations; ?format=prometheus or plain
+        # ``Accept: text/plain`` gets the classic text format, whose
+        # parsers reject exemplar syntax; everything else the
+        # back-compat nested JSON (which always carries the
+        # ``exemplars`` maps)
         fmt = (query_params or {}).get("format", "")
         accept = _header(headers, "accept") or ""
+        if fmt == "openmetrics" or "application/openmetrics-text" in accept:
+            return 200, self.telemetry.render_prometheus(openmetrics=True)
         if fmt == "prometheus" or "text/plain" in accept:
             return 200, self.telemetry.render_prometheus()
         return 200, self._metrics()
+
+    def _ops_events(self, query_params: dict | None) -> tuple[int, dict]:
+        """The flight recorder, filtered: ``?since=<seq>`` returns only
+        newer events (pass the previous response's ``lastSeq`` to
+        tail), ``?kind=breaker`` filters by kind prefix."""
+        qp = query_params or {}
+        try:
+            since = int(qp.get("since") or 0)
+            limit = int(qp.get("limit") or 256)
+        except (TypeError, ValueError):
+            return 400, self.env.error(
+                400, "since/limit must be integers"
+            )
+        return 200, {
+            "events": journal.events(
+                since=since, kind=str(qp.get("kind") or ""), limit=limit
+            ),
+            "lastSeq": journal.last_seq(),
+            "published": journal.published(),
+            "enabled": journal.enabled,
+        }
+
+    def _debug_status(self) -> dict:
+        """The self-diagnosis rollup: SLO state, breaker states,
+        replica-table staleness, queue depths, and the queue-wait
+        decomposition composed into one document whose ``diagnosis``
+        names the stage and worker eating the latency budget. Local
+        state only — safe to serve while saturated."""
+        engine = self.engine
+        local = getattr(engine, "local", None) or engine
+        breaker = getattr(engine, "breaker", None)
+        breakers = breaker.metrics() if breaker is not None else {}
+        routing: dict = {}
+        router = getattr(engine, "router", None)
+        if router is not None:
+            age = engine.route_table_age_s()
+            routing = {
+                "datasets": len(router.table()),
+                "replicas": router.replica_count(),
+                "tableAgeS": None if age is None else round(age, 1),
+                "unavailableDatasets": engine.unavailable_datasets(),
+                "workers": engine.worker_stats(),
+            }
+        batcher = getattr(local, "_batcher", None)
+        occ = batcher.occupancy() if batcher is not None else {}
+        queues = {
+            "admission": self.admission.metrics(),
+            "runner": self.query_runner.metrics(),
+            "batcher": {
+                k: occ[k] for k in ("launcher", "fetcher") if k in occ
+            },
+        }
+        # stage decomposition: runner admission wait first, then the
+        # batcher/engine stages (batch wait -> encode -> launch ->
+        # device -> fetch -> materialize)
+        stages: dict = {
+            "admission_wait_ms": self.query_runner.queue_wait_summary()
+        }
+        st = getattr(local, "stage_timing", None)
+        if st is not None:
+            stages.update(st())
+        slo = self.slo.snapshot()
+        breached = sorted(
+            r for r, doc in slo["routes"].items() if doc["breached"]
+        )
+        stage_p99 = {
+            name: q.get("p99", 0.0)
+            for name, q in stages.items()
+            if isinstance(q, dict) and q
+        }
+        slowest_stage = (
+            max(stage_p99, key=stage_p99.get)
+            if any(stage_p99.values())
+            else None
+        )
+        workers = routing.get("workers") or {}
+        rtts = {
+            u: w["medianRttMs"]
+            for u, w in workers.items()
+            if w.get("medianRttMs") is not None
+        }
+        return {
+            "ready": bool(self.ready),
+            "beaconId": self.config.info.beacon_id,
+            "slo": slo,
+            "breakers": breakers,
+            "routing": routing,
+            "queues": queues,
+            "stages": stages,
+            "events": {
+                "lastSeq": journal.last_seq(),
+                "published": journal.published(),
+            },
+            "diagnosis": {
+                "breachedSlos": breached,
+                "openBreakers": sorted(
+                    u
+                    for u, d in breakers.items()
+                    if d.get("state") != "closed"
+                ),
+                "slowestStage": slowest_stage,
+                "slowestWorker": (
+                    max(rtts, key=rtts.get) if rtts else None
+                ),
+            },
+        }
 
     def _metrics(self) -> dict:
         """Serving observability: the typed-instrument registry rendered

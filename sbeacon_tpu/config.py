@@ -297,11 +297,35 @@ class ObservabilityConfig:
     slow_query_log: optional file the slow-query JSON lines append to.
     profile_dir: arms ``jax.profiler`` capture of kernel launch/fetch
       regions into this directory (the ``SBEACON_PROFILE`` env var).
+
+    SLO engine (slo.py, served at ``/slo`` + ``slo.*`` gauges):
+    slo_availability_target: default max-good-ratio objective per route
+      (0.999 = at most 0.1% 5xx within budget).
+    slo_latency_ms / slo_latency_target: default latency objective —
+      at least ``slo_latency_target`` of non-5xx requests under
+      ``slo_latency_ms`` milliseconds.
+    slo_routes: per-route overrides, compact
+      ``route:field=value[:field=value...]`` comma list (e.g.
+      ``g_variants:latency_ms=50,info:availability=0.99``).
+    slo_alert_burn_rate: burn factor that, sustained on BOTH the fast
+      (5m) and slow (1h) windows, marks a route breached (14.4 is the
+      SRE-workbook fast-page factor).
+
+    Flight recorder (telemetry.EventJournal, served at ``/ops/events``):
+    event_journal: enables control-plane event publication.
+    event_journal_size: events kept in the bounded ring.
     """
 
     slow_query_ms: float = 1000.0
     slow_query_log: str = ""
     profile_dir: str = ""
+    slo_availability_target: float = 0.999
+    slo_latency_ms: float = 250.0
+    slo_latency_target: float = 0.99
+    slo_routes: str = ""
+    slo_alert_burn_rate: float = 14.4
+    event_journal: bool = True
+    event_journal_size: int = 1024
 
 
 @dataclasses.dataclass(frozen=True)
@@ -472,6 +496,21 @@ class BeaconConfig:
             obs_over["slow_query_log"] = env["SBEACON_SLOW_QUERY_LOG"]
         if "SBEACON_PROFILE" in env:
             obs_over["profile_dir"] = env["SBEACON_PROFILE"]
+        _obs_env = {
+            "BEACON_SLO_AVAILABILITY": ("slo_availability_target", float),
+            "BEACON_SLO_LATENCY_MS": ("slo_latency_ms", float),
+            "BEACON_SLO_LATENCY_TARGET": ("slo_latency_target", float),
+            "BEACON_SLO_ROUTES": ("slo_routes", str),
+            "BEACON_SLO_ALERT_BURN": ("slo_alert_burn_rate", float),
+            "BEACON_EVENT_JOURNAL_SIZE": ("event_journal_size", int),
+        }
+        for var, (field, conv) in _obs_env.items():
+            if var in env:
+                obs_over[field] = conv(env[var])
+        if "BEACON_EVENT_JOURNAL_ENABLED" in env:
+            obs_over["event_journal"] = (
+                env["BEACON_EVENT_JOURNAL_ENABLED"].lower() not in _off
+            )
         observability = ObservabilityConfig(**obs_over)
         return BeaconConfig(
             info=info,

@@ -39,7 +39,7 @@ from .ops import make_device_index, run_queries_auto
 from .ops.kernel import QuerySpec, encode_queries
 from .payloads import VariantQueryPayload, VariantSearchResponse
 from .response_cache import ResponseCache, response_cache_key
-from .telemetry import annotate
+from .telemetry import annotate, percentiles, publish_event
 from .utils.chrom import chromosome_code
 from .utils.trace import span
 
@@ -1040,27 +1040,31 @@ class VariantEngine:
             "engine.materialize_ms",
             "host materialisation quantiles",
             label="quantile",
-            fn=lambda: self.stage_timing()["materialize_ms"],
+            fn=self._materialize_timing,
         )
         if self._batcher is not None:
             self._batcher.register_metrics(registry)
         register_cache_metrics(registry, lambda: self._response_cache)
 
-    def stage_timing(self) -> dict:
-        """Host materialisation percentiles (the stage after the
-        batcher's encode/launch/fetch), over the bounded window."""
+    def _materialize_timing(self) -> dict:
+        """Host-materialisation quantiles alone — the gauge callback
+        reads just this, so a /metrics render doesn't also pay the
+        batcher's full per-stage summary."""
         with self._mat_lock:
             xs = list(self._mat_ms)
-        if not xs:
-            return {"materialize_ms": {}}
-        a = np.asarray(xs)
-        return {
-            "materialize_ms": {
-                "p50": round(float(np.percentile(a, 50)), 2),
-                "p95": round(float(np.percentile(a, 95)), 2),
-                "p99": round(float(np.percentile(a, 99)), 2),
-            }
-        }
+        return percentiles(xs)
+
+    def stage_timing(self) -> dict:
+        """The full per-stage latency decomposition: the batcher's
+        queue-wait/encode/launch/device/fetch quantiles (when a batcher
+        serves) plus host materialisation — the stage after fetch —
+        over the bounded windows. ``/debug/status`` and the bench soak
+        read this one dict to attribute a tail to a stage."""
+        out: dict = {}
+        if self._batcher is not None:
+            out.update(self._batcher.timing_summary())
+        out["materialize_ms"] = self._materialize_timing()
+        return out
 
     def _fused_ready(self, wait: bool = False):
         """(FusedDeviceIndex, key->shard_id, key->shard-snapshot) over
@@ -1168,6 +1172,9 @@ class VariantEngine:
                 # stale — drop it; the next query rebuilds fresh
                 return None
             self._fused_state = state
+        publish_event(
+            "engine.fused_rebuild", shards=len(keys), rows=total
+        )
         logging.getLogger(__name__).info(
             "fused index ready: %d shards, %d rows", len(keys), total
         )

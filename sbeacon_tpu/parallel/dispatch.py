@@ -74,6 +74,7 @@ from ..telemetry import (
     RequestContext,
     annotate,
     current_context,
+    publish_event,
     request_context,
     sanitize_trace_id,
 )
@@ -457,8 +458,19 @@ class ReplicaRouter:
     # -- table --------------------------------------------------------------
 
     def publish(self, table: dict[str, tuple[str, ...]]) -> None:
+        new = {ds: tuple(urls) for ds, urls in table.items()}
         with self._lock:
-            self._table = {ds: tuple(urls) for ds, urls in table.items()}
+            changed = new != self._table
+            self._table = new
+        if changed:
+            # flight-recorder: only actual topology changes are events
+            # (the rediscovery loop republishes every pass — an
+            # unchanged table is not a transition)
+            publish_event(
+                "routing.table_publish",
+                datasets=len(new),
+                replicas=sum(len(u) for u in new.values()),
+            )
 
     def table(self) -> dict[str, tuple[str, ...]]:
         with self._lock:
@@ -492,6 +504,11 @@ class ReplicaRouter:
                 return None
             s = sorted(ring)
         return s[len(s) // 2]
+
+    def median_rtt_ms(self, url: str) -> float | None:
+        """Public median-RTT view (``/debug/status`` worker rollup)."""
+        rtt = self._rtt(url)
+        return None if rtt is None else round(rtt * 1e3, 2)
 
     def hedge_delay(self, hedge_delay_s: float | None) -> float | None:
         """Seconds to wait before racing a second replica, with the
@@ -686,10 +703,11 @@ class ScanWorkerPool:
                 )
             return self._hedge_exec
 
-    def _note_hedge(self) -> None:
+    def _note_hedge(self, primary: str, hedge: str) -> None:
         with self._lock:
             self._hedges += 1
         note_hedge()  # process-wide transport.hedges counter
+        publish_event("scan.hedge", primary=primary, hedge=hedge)
 
     def stats(self) -> dict:
         with self._lock:
@@ -786,7 +804,7 @@ class ScanWorkerPool:
         if not done and started.is_set():
             other = self._pick_other(url)
             if other is not None:
-                self._note_hedge()
+                self._note_hedge(url, other)
                 futs[
                     pool.submit(self._scan_once, other, body, headers)
                 ] = other
@@ -808,6 +826,9 @@ class ScanWorkerPool:
                     if u != url:  # the hedge beat the primary
                         with self._lock:
                             self._hedge_wins += 1
+                        publish_event(
+                            "scan.hedge_won", winner=u, primary=url
+                        )
                     return got, last
         return None, last
 
@@ -991,6 +1012,9 @@ class DistributedEngine:
         self._last_seen: dict[str, list[tuple[str, str]]] = {}
         self._reachable: set[str] = set()
         self._retention_warned: set[str] = set()
+        # monotonic stamp of the last completed discovery pass — the
+        # /debug/status replica-table staleness signal
+        self._last_publish_mono: float | None = None
         # replica selection (p2c over RTTs, breaker-aware) owns the
         # dataset -> replica-urls table; every /search routing decision
         # goes through router.pick — never by indexing a routes dict
@@ -1073,6 +1097,29 @@ class DistributedEngine:
                 "rediscoveries": self._rediscoveries,
                 "replicas": self.router.replica_count(),
             }
+
+    def route_table_age_s(self) -> float | None:
+        """Seconds since the last completed discovery pass published
+        the replica table (None before first discovery) — the
+        staleness signal ``/debug/status`` reports."""
+        with self._routes_lock:
+            t = self._last_publish_mono
+        return None if t is None else time.monotonic() - t
+
+    def worker_stats(self) -> dict[str, dict]:
+        """Per-worker health rollup for ``/debug/status``: breaker
+        state, recent median RTT, and whether the latest discovery
+        pass reached it. Local state only — never a worker call."""
+        with self._routes_lock:
+            reachable = set(self._reachable)
+        return {
+            url: {
+                "state": self.breaker.state(url),
+                "medianRttMs": self.router.median_rtt_ms(url),
+                "reachable": url in reachable,
+            }
+            for url in self.worker_urls
+        }
 
     def unavailable_datasets(self) -> list[str]:
         """Datasets in the route table with no live replica (every
@@ -1228,6 +1275,7 @@ class DistributedEngine:
             # workers too: the aggregate index identity (cache keys)
             # must not flap with reachability
             self._fingerprints.update(fps)
+            self._last_publish_mono = time.monotonic()
             self.router.publish(table)
         return table
 
@@ -1288,9 +1336,16 @@ class DistributedEngine:
                 # healed = every configured worker ANSWERED the latest
                 # pass (not merely has a retained fingerprint from
                 # before it died)
+                reachable = len(self._reachable)
                 healed = all(
                     url in self._reachable for url in self.worker_urls
                 )
+            publish_event(
+                "routing.rediscovery",
+                healed=healed,
+                reachable=reachable,
+                workers=len(self.worker_urls),
+            )
             if healed:
                 return
 
@@ -1468,6 +1523,7 @@ class DistributedEngine:
         if not done and started.is_set():
             note_hedge()  # process-wide transport.hedges counter
             annotate(replica_hedge=True)
+            publish_event("dispatch.hedge", primary=url, hedge=other)
             futs[
                 pool.submit(self._call_worker, other, payload, deadline, ctx)
             ] = other
@@ -1486,6 +1542,10 @@ class DistributedEngine:
                     if u != url:
                         tried.add(u)
                     continue
+                if u != url:  # the hedge answered first
+                    publish_event(
+                        "dispatch.hedge_won", winner=u, primary=url
+                    )
                 return out
         raise last
 
@@ -1542,6 +1602,12 @@ class DistributedEngine:
                 with self._sc_lock:
                     self._failovers += 1
                 annotate(failover=True)
+                publish_event(
+                    "dispatch.failover",
+                    failed=u,
+                    to=nu,
+                    datasets=len(nds),
+                )
                 work.append((nu, nds, tried | {nu}))
         return responses, failed, first_err
 
@@ -1716,6 +1782,9 @@ class DistributedEngine:
                 with self._sc_lock:
                     self._partials += 1
                 annotate(unavailable_datasets=tuple(unavailable))
+                publish_event(
+                    "dispatch.partial", datasets=list(unavailable)
+                )
                 log.warning(
                     "partial results: no reachable replica for %s (%s)",
                     unavailable,

@@ -31,6 +31,7 @@ import sqlite3
 import threading
 import time
 import uuid
+from collections import deque
 from enum import Enum
 from pathlib import Path
 
@@ -44,7 +45,12 @@ from .resilience import (
     current_deadline,
     deadline_scope,
 )
-from .telemetry import annotate, current_context, request_context
+from .telemetry import (
+    annotate,
+    current_context,
+    percentiles,
+    request_context,
+)
 from .utils.trace import span
 
 
@@ -488,6 +494,12 @@ class AsyncQueryRunner:
         self._lock = threading.Lock()
         self._last_purge = time.time()
         self._sweeper: threading.Thread | None = None
+        # admission-wait decomposition: submit -> execution start on
+        # the bounded pool (the stage BEFORE the batcher's queue wait).
+        # Ring for exact percentiles; the runner.queue_wait_ms
+        # histogram feeds once an app registry wires it
+        self._wait_ms: deque = deque(maxlen=4096)
+        self._wait_hist = None
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -524,6 +536,27 @@ class AsyncQueryRunner:
             "runner submissions shed with 429",
             fn=lambda: self._gate.metrics()["shed"],
         )
+        # the admission-wait slice of the queue-wait decomposition
+        # (/debug/status composes it ahead of the batcher stages)
+        self._wait_hist = registry.histogram(
+            "runner.queue_wait_ms",
+            "async-runner submit -> execution-start wait",
+        )
+
+    def _note_queue_wait(self, wait_ms: float) -> None:
+        with self._lock:
+            self._wait_ms.append(wait_ms)
+        h = self._wait_hist
+        if h is not None:
+            h.observe(wait_ms)
+
+    def queue_wait_summary(self) -> dict:
+        """Percentiles of the runner's admission wait over the bounded
+        ring (empty dict before any async execution) — same summary
+        semantics as every other stage in /debug/status."""
+        with self._lock:
+            xs = list(self._wait_ms)
+        return percentiles(xs)
 
     def _maybe_purge(self) -> None:
         now = time.time()
@@ -625,8 +658,12 @@ class AsyncQueryRunner:
         # coordinator->worker hop — keep the ingress trace id.
         job_deadline = current_deadline()
         job_ctx = current_context()
+        t_enqueue = time.perf_counter()
 
         def run():
+            self._note_queue_wait(
+                (time.perf_counter() - t_enqueue) * 1e3
+            )
             with request_context(job_ctx), span(
                 "query_jobs.run", query_id=query_id
             ):

@@ -28,6 +28,8 @@ import threading
 import time
 from contextlib import contextmanager
 
+from .telemetry import publish_event
+
 
 # -- typed failures -----------------------------------------------------------
 
@@ -172,6 +174,10 @@ class AdmissionController:
     accept backlog until something times out.
     """
 
+    #: minimum seconds between admission-shed flight-recorder events —
+    #: a 429 flood is ONE incident, not thousands of journal entries
+    SHED_EVENT_INTERVAL_S = 1.0
+
     def __init__(
         self, max_in_flight: int = 64, *, retry_after_s: float = 1.0
     ):
@@ -183,6 +189,7 @@ class AdmissionController:
         self._in_flight = 0
         self._admitted = 0
         self._shed = 0
+        self._last_shed_event = 0.0
 
     def try_acquire(self) -> bool:
         """Take one slot if available (False = shed, counted); callers
@@ -191,10 +198,26 @@ class AdmissionController:
         with self._lock:
             if self._in_flight >= self.max_in_flight:
                 self._shed += 1
-                return False
-            self._in_flight += 1
-            self._admitted += 1
-            return True
+                now = time.monotonic()
+                fire = (
+                    now - self._last_shed_event
+                    >= self.SHED_EVENT_INTERVAL_S
+                )
+                if fire:
+                    self._last_shed_event = now
+                shed, in_flight = self._shed, self._in_flight
+            else:
+                self._in_flight += 1
+                self._admitted += 1
+                return True
+        if fire:  # journal write outside the hot-path lock
+            publish_event(
+                "admission.shed",
+                shed=shed,
+                in_flight=in_flight,
+                max_in_flight=self.max_in_flight,
+            )
+        return False
 
     def release(self) -> None:
         with self._lock:
@@ -310,38 +333,49 @@ class CircuitBreaker:
         return c
 
     def allow(self, key: str) -> bool:
-        with self._lock:
-            c = self._get(key)
-            if c.state == CLOSED:
-                return True
-            now = self._clock()
-            if c.state == OPEN:
-                if now - c.opened_at < self.reset_timeout_s:
-                    return False
-                c.state = HALF_OPEN
-                c.opened_at = now  # stamp half-open entry for the
-                c.probes_left = self.half_open_probes  # escape below
-            if c.probes_left > 0:
-                c.probes_left -= 1
-                return True
-            # every probe was consumed but no outcome was ever recorded
-            # (probe holder died before the call, deadline expired
-            # between allow() and the attempt, non-conclusive response):
-            # HALF_OPEN must not be a terminal state — replenish after
-            # another reset window, like a fresh open->half-open lapse
-            if now - c.opened_at >= self.reset_timeout_s:
-                c.opened_at = now
-                c.probes_left = self.half_open_probes - 1
-                return True
-            return False
+        half_opened = False
+        try:
+            with self._lock:
+                c = self._get(key)
+                if c.state == CLOSED:
+                    return True
+                now = self._clock()
+                if c.state == OPEN:
+                    if now - c.opened_at < self.reset_timeout_s:
+                        return False
+                    c.state = HALF_OPEN
+                    c.opened_at = now  # stamp half-open entry for the
+                    c.probes_left = self.half_open_probes  # escape below
+                    half_opened = True
+                if c.probes_left > 0:
+                    c.probes_left -= 1
+                    return True
+                # every probe was consumed but no outcome was ever
+                # recorded (probe holder died before the call, deadline
+                # expired between allow() and the attempt,
+                # non-conclusive response): HALF_OPEN must not be a
+                # terminal state — replenish after another reset
+                # window, like a fresh open->half-open lapse
+                if now - c.opened_at >= self.reset_timeout_s:
+                    c.opened_at = now
+                    c.probes_left = self.half_open_probes - 1
+                    return True
+                return False
+        finally:
+            if half_opened:
+                publish_event("breaker.half_open", route=key)
 
     def record_success(self, key: str) -> None:
         with self._lock:
             c = self._get(key)
+            closed = c.state != CLOSED
             c.state = CLOSED
             c.failures = 0
+        if closed:
+            publish_event("breaker.close", route=key)
 
     def record_failure(self, key: str) -> None:
+        opened = False
         with self._lock:
             c = self._get(key)
             c.failures += 1
@@ -349,8 +383,14 @@ class CircuitBreaker:
             if reopen or c.failures >= self.failure_threshold:
                 if c.state != OPEN:
                     c.opens += 1
+                    opened = True
                 c.state = OPEN
                 c.opened_at = self._clock()
+            failures = c.failures
+        if opened:
+            publish_event(
+                "breaker.open", route=key, consecutive_failures=failures
+            )
 
     def state(self, key: str) -> str:
         with self._lock:

@@ -35,6 +35,7 @@ import time
 from collections import OrderedDict
 
 from .payloads import VariantQueryPayload, VariantSearchResponse
+from .telemetry import publish_event
 
 
 def copy_response(r: VariantSearchResponse) -> VariantSearchResponse:
@@ -137,8 +138,10 @@ class ResponseCache:
         """Drop everything (index set changed: the fingerprint in the
         key already makes old entries unreachable, this frees them)."""
         with self._lock:
+            dropped = len(self._entries)
             self._entries.clear()
             self._invalidations += 1
+        publish_event("response_cache.invalidated", entries=dropped)
 
     def stats(self) -> dict:
         with self._lock:

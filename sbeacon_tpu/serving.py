@@ -39,7 +39,7 @@ from .resilience import (
     DeadlineExceeded,
     current_deadline,
 )
-from .telemetry import annotate, profile_region
+from .telemetry import annotate, percentiles, profile_region
 from .utils.trace import span
 
 
@@ -190,6 +190,11 @@ class MicroBatcher:
         self._encode_ms: deque = deque(maxlen=timing_window)
         self._launch_ms: deque = deque(maxlen=timing_window)
         self._fetch_ms: deque = deque(maxlen=timing_window)
+        # queue-wait decomposition histogram (batcher.stage_ms, stage
+        # label): the same points that feed the rings observe here once
+        # an app registry wired it (register_metrics). None until then,
+        # so engines without an app pay one attribute read
+        self._stage_hist = None
         # resilience observability: submits that expired before their
         # launch (leader-side filter) / timed out waiting (follower)
         self._n_expired = 0
@@ -605,25 +610,13 @@ class MicroBatcher:
         client_latency ~= queue_wait + exec + HTTP/materialisation
         overhead — the soak harness reports all of these so tails are
         attributable to a stage."""
-        import numpy as np
-
-        def pct(xs):
-            if not xs:
-                return {}
-            a = np.asarray(xs)
-            return {
-                "p50": round(float(np.percentile(a, 50)), 2),
-                "p95": round(float(np.percentile(a, 95)), 2),
-                "p99": round(float(np.percentile(a, 99)), 2),
-            }
-
         with self._stats_lock:
             return {
-                "queue_wait_ms": pct(list(self._wait_ms)),
-                "exec_ms": pct(list(self._exec_ms)),
-                "encode_ms": pct(list(self._encode_ms)),
-                "launch_ms": pct(list(self._launch_ms)),
-                "fetch_ms": pct(list(self._fetch_ms)),
+                "queue_wait_ms": percentiles(self._wait_ms),
+                "exec_ms": percentiles(self._exec_ms),
+                "encode_ms": percentiles(self._encode_ms),
+                "launch_ms": percentiles(self._launch_ms),
+                "fetch_ms": percentiles(self._fetch_ms),
             }
 
     def occupancy(self) -> dict:
@@ -774,6 +767,16 @@ class MicroBatcher:
             label="quantile",
             fn=timing("fetch_ms"),
         )
+        # the end-to-end queue-wait decomposition as ONE labeled
+        # histogram (batch_wait per submission; encode/launch/device/
+        # fetch once per launch): dashboards see which stage eats the
+        # latency budget without diffing five quantile gauges
+        self._stage_hist = registry.histogram(
+            "batcher.stage_ms",
+            "per-stage latency decomposition "
+            "(batch_wait/encode/launch/device/fetch)",
+            label="stage",
+        )
 
     def _execute(self, acc, batch, dindex, window_cap, record_cap):
         """LAUNCH stage (launcher thread): flatten the batch's specs,
@@ -804,6 +807,13 @@ class MicroBatcher:
             )
             for p in batch:
                 self._wait_ms.append((t_launch - p.t_submit) * 1e3)
+        stage_hist = self._stage_hist
+        if stage_hist is not None:
+            for p in batch:
+                stage_hist.observe(
+                    (t_launch - p.t_submit) * 1e3,
+                    label_value="batch_wait",
+                )
         try:
             with span("serving.microbatch") as sp, profile_region(
                 "sbeacon.kernel.launch"
@@ -835,6 +845,13 @@ class MicroBatcher:
         with self._stats_lock:
             self._encode_ms.append((t_enc - t_launch) * 1e3)
             self._launch_ms.append((t_disp - t_enc) * 1e3)
+        if stage_hist is not None:
+            stage_hist.observe(
+                (t_enc - t_launch) * 1e3, label_value="encode"
+            )
+            stage_hist.observe(
+                (t_disp - t_enc) * 1e3, label_value="launch"
+            )
         try:
             self._fetcher.submit(
                 self._fetch_batch,
@@ -869,6 +886,14 @@ class MicroBatcher:
                 self._fetch_ms.append((t_done - t_disp) * 1e3)
                 for _ in batch:
                     self._exec_ms.append(exec_ms)
+            stage_hist = self._stage_hist
+            if stage_hist is not None:
+                # device = launch -> results (exec), fetch = the
+                # readback tail of it; once per launch
+                stage_hist.observe(exec_ms, label_value="device")
+                stage_hist.observe(
+                    (t_done - t_disp) * 1e3, label_value="fetch"
+                )
             for p, off in zip(batch, offsets):
                 sl = slice(off, off + len(p.specs))
                 p.result = QueryResults(

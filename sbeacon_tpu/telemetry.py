@@ -27,6 +27,14 @@ This module is the single plane the stack wires through:
   batcher and async runner already do for deadlines, propagated as a
   header on every coordinator->worker call so worker-side spans parent
   correctly (the Dapper model), and returned in the response envelope.
+- **Flight recorder** (:class:`EventJournal`): a bounded structured
+  journal the control plane publishes transition events into (breaker
+  state changes, replica failovers, hedges, rediscovery passes,
+  route-table publishes, cache invalidations, admission sheds), each
+  stamped with monotonic + wall time and the ambient trace id; served
+  at ``/ops/events``. Histograms can additionally carry **exemplars**
+  — the trace id of the latest observation per bucket — so a slow
+  latency bucket links directly to the request that landed in it.
 - **Profiling + slow-query hooks**: ``SBEACON_PROFILE=<dir>`` arms
   :func:`profile_region` so kernel launch/fetch run under
   ``jax.profiler`` trace annotations; :class:`SlowQueryLog` records a
@@ -39,6 +47,7 @@ profiling is armed) and importable from any layer, like resilience.py.
 
 from __future__ import annotations
 
+import collections
 import json
 import logging
 import os
@@ -71,12 +80,17 @@ class _Instrument:
     callback returns the current value — a number, or a
     ``{label_value: number}`` dict when ``label`` is set. Without
     ``fn`` the instrument owns its value(s) under a short lock.
+
+    ``label`` may also be a TUPLE of label names (e.g. ``("route",
+    "window")``): the value dict is then keyed by matching tuples of
+    label values, rendered as multi-label Prometheus series and as
+    nested maps in the JSON snapshot.
     """
 
     kind = "untyped"
 
     def __init__(self, name: str, help: str = "", *,
-                 fn=None, label: str | None = None, json_render: bool = True):
+                 fn=None, label=None, json_render: bool = True):
         if not _NAME_RE.match(name):
             raise ValueError(
                 f"metric name {name!r} must be dotted lowercase "
@@ -86,6 +100,12 @@ class _Instrument:
         self.help = help
         self.fn = fn
         self.label = label
+        #: normalized label-name tuple (None = unlabeled)
+        self.labels: tuple[str, ...] | None = (
+            None
+            if label is None
+            else (label,) if isinstance(label, str) else tuple(label)
+        )
         #: False = Prometheus-only (used where the back-compat JSON
         #: shape differs from the dotted nesting, e.g. breaker state)
         self.json_render = json_render
@@ -145,20 +165,38 @@ class Histogram(_Instrument):
     bucket scan over the fixed boundary tuple (13 compares) — no
     allocation. Buckets are cumulative at render time, Prometheus
     semantics.
+
+    With ``exemplars=True`` each observation may carry a trace id
+    (explicit ``exemplar=`` argument, or the ambient request context's
+    id): the most recent (trace id, value, wall time) is kept per
+    bucket, so a slow bucket on a dashboard links straight to the
+    distributed trace that landed in it (``/_trace?trace_id=...``).
+    Rendered as OpenMetrics ``# {trace_id="..."} value ts`` suffixes in
+    the text exposition and an ``exemplars`` map in the JSON snapshot.
+    Memory is bounded by (label values x buckets) — one slot each.
     """
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "", *,
                  buckets: tuple = LATENCY_BUCKETS_MS,
-                 label: str | None = None):
+                 label: str | None = None,
+                 exemplars: bool = False):
         super().__init__(name, help, label=label)
         self.buckets = tuple(float(b) for b in buckets)
+        self.exemplars_enabled = bool(exemplars)
         # label_value (or "") -> [counts per bucket + overflow, count, sum]
         self._series: dict[str, list] = {}
+        # (label_value, le) -> (trace_id, observed value, wall time)
+        self._exemplars: dict[tuple[str, str], tuple] = {}
 
-    def observe(self, v: float, *, label_value: str | None = None) -> None:
+    def observe(self, v: float, *, label_value: str | None = None,
+                exemplar: str | None = None) -> None:
         key = label_value if label_value is not None else ""
+        if self.exemplars_enabled and exemplar is None:
+            ctx = current_context()
+            if ctx is not None:
+                exemplar = ctx.trace_id
         with self._lock:
             s = self._series.get(key)
             if s is None:
@@ -166,18 +204,23 @@ class Histogram(_Instrument):
                     [0] * (len(self.buckets) + 1), 0, 0.0
                 ]
             counts, _n, _sum = s
+            le = "+Inf"
             for i, b in enumerate(self.buckets):
                 if v <= b:
                     counts[i] += 1
+                    le = f"{b:g}"
                     break
             else:
                 counts[-1] += 1
             s[1] += 1
             s[2] += v
+            if self.exemplars_enabled and exemplar:
+                self._exemplars[(key, le)] = (exemplar, v, time.time())
 
     def collect(self):
-        """{label_value: {"count", "sum", "buckets": {le: cumulative}}}
-        (unlabeled histograms use the single key ``""``)."""
+        """{label_value: {"count", "sum", "buckets": {le: cumulative}
+        [, "exemplars": {le: {traceId, value, time}}]}} (unlabeled
+        histograms use the single key ``""``)."""
         out = {}
         with self._lock:
             for key, (counts, n, total) in self._series.items():
@@ -191,6 +234,14 @@ class Histogram(_Instrument):
                     "sum": round(total, 3),
                     "buckets": cum,
                 }
+            for (key, le), (tid, v, t) in self._exemplars.items():
+                series = out.get(key)
+                if series is not None:
+                    series.setdefault("exemplars", {})[le] = {
+                        "traceId": tid,
+                        "value": round(v, 4),
+                        "time": round(t, 3),
+                    }
         return out
 
 
@@ -214,14 +265,14 @@ class MetricsRegistry:
         return inst
 
     def counter(self, name: str, help: str = "", *,
-                fn=None, label: str | None = None,
+                fn=None, label=None,
                 json_render: bool = True) -> Counter:
         return self._register(
             Counter(name, help, fn=fn, label=label, json_render=json_render)
         )
 
     def gauge(self, name: str, help: str = "", *,
-              fn=None, label: str | None = None,
+              fn=None, label=None,
               json_render: bool = True) -> Gauge:
         return self._register(
             Gauge(name, help, fn=fn, label=label, json_render=json_render)
@@ -229,9 +280,10 @@ class MetricsRegistry:
 
     def histogram(self, name: str, help: str = "", *,
                   buckets: tuple = LATENCY_BUCKETS_MS,
-                  label: str | None = None) -> Histogram:
+                  label: str | None = None,
+                  exemplars: bool = False) -> Histogram:
         return self._register(Histogram(name, help, buckets=buckets,
-                                        label=label))
+                                        label=label, exemplars=exemplars))
 
     def names(self) -> list[str]:
         with self._lock:
@@ -259,6 +311,20 @@ class MetricsRegistry:
                 # unlabel single-series histograms for readability
                 if set(val) == {""}:
                     val = val[""]
+            elif (
+                isinstance(val, dict)
+                and val
+                and isinstance(next(iter(val)), tuple)
+            ):
+                # multi-label series nest by label value:
+                # {("g_variants", "5m"): 2.0} -> {"g_variants": {"5m": 2.0}}
+                nested: dict = {}
+                for key_tuple, v in val.items():
+                    node = nested
+                    for part in key_tuple[:-1]:
+                        node = node.setdefault(str(part), {})
+                    node[str(key_tuple[-1])] = v
+                val = nested
             node = out
             parts = inst.name.split(".")
             for p in parts[:-1]:
@@ -266,9 +332,13 @@ class MetricsRegistry:
             node[parts[-1]] = val
         return out
 
-    def render_prometheus(self) -> str:
-        """Prometheus/OpenMetrics-style text exposition. Dotted names
-        flatten to underscores under the ``sbeacon_`` namespace."""
+    def render_prometheus(self, *, openmetrics: bool = False) -> str:
+        """Prometheus text exposition. Dotted names flatten to
+        underscores under the ``sbeacon_`` namespace. Exemplar
+        annotations are only legal in the OpenMetrics dialect — the
+        classic text format's parser rejects them — so they render
+        only with ``openmetrics=True`` (which also appends the
+        spec-required ``# EOF`` terminator)."""
         lines: list[str] = []
         for inst in self._snapshot():
             val = inst.collect()
@@ -278,30 +348,74 @@ class MetricsRegistry:
             if inst.help:
                 lines.append(f"# HELP {pname} {inst.help}")
             lines.append(f"# TYPE {pname} {inst.kind}")
+            # OpenMetrics requires counter SAMPLES to be named
+            # <family>_total (the TYPE line keeps the family name);
+            # the classic format rejects the suffix form instead
+            sname = (
+                pname + "_total"
+                if openmetrics and inst.kind == "counter"
+                else pname
+            )
             if inst.kind == "histogram":
                 label = inst.label
                 for key, series in sorted(val.items()):
                     base = f'{label}="{_esc(key)}",' if label and key else ""
+                    exem = (
+                        series.get("exemplars") or {}
+                        if openmetrics
+                        else {}
+                    )
                     for le, cum in series["buckets"].items():
-                        lines.append(
-                            f'{pname}_bucket{{{base}le="{le}"}} {cum}'
-                        )
+                        line = f'{pname}_bucket{{{base}le="{le}"}} {cum}'
+                        ex = exem.get(le)
+                        if ex is not None:
+                            # OpenMetrics exemplar: the most recent
+                            # observation that landed in this bucket,
+                            # linked to its distributed trace
+                            line += (
+                                f' # {{trace_id="{_esc(ex["traceId"])}"}}'
+                                f' {_num(ex["value"])} {ex["time"]:.3f}'
+                            )
+                        lines.append(line)
                     sfx = f"{{{base[:-1]}}}" if base else ""
                     lines.append(f"{pname}_sum{sfx} {series['sum']}")
                     lines.append(f"{pname}_count{sfx} {series['count']}")
             elif isinstance(val, dict):
-                label = inst.label or "key"
+                labels = inst.labels or ("key",)
                 for key, v in sorted(val.items()):
-                    lines.append(
-                        f'{pname}{{{label}="{_esc(str(key))}"}} {_num(v)}'
+                    vals = key if isinstance(key, tuple) else (key,)
+                    lbl = ",".join(
+                        f'{ln}="{_esc(str(lv))}"'
+                        for ln, lv in zip(labels, vals)
                     )
+                    lines.append(f"{sname}{{{lbl}}} {_num(v)}")
             else:
-                lines.append(f"{pname} {_num(val)}")
+                lines.append(f"{sname} {_num(val)}")
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
 
 def _esc(s: str) -> str:
     return s.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def percentiles(xs) -> dict:
+    """{p50, p95, p99} of a sample window (numpy-interpolated, 2dp),
+    or {} when empty — the one summary shape every stage-timing
+    producer (batcher, engine materialisation, runner admission wait)
+    feeds into /debug/status and the bench records."""
+    xs = list(xs)
+    if not xs:
+        return {}
+    import numpy as np
+
+    a = np.asarray(xs)
+    return {
+        "p50": round(float(np.percentile(a, 50)), 2),
+        "p95": round(float(np.percentile(a, 95)), 2),
+        "p99": round(float(np.percentile(a, 99)), 2),
+    }
 
 
 def _num(v) -> str:
@@ -454,6 +568,125 @@ class SlowQueryLog:
             except OSError:  # a full disk must not fail the request
                 log.exception("slow-query log write failed")
         return True
+
+
+# -- flight recorder (control-plane event journal) ----------------------------
+
+
+class EventJournal:
+    """Bounded structured journal of control-plane transitions — the
+    flight recorder. Breaker opens/closes, replica failovers, hedges,
+    rediscovery passes, route-table publishes, cache invalidations,
+    fused-stack rebuilds and admission sheds each publish ONE small
+    event here, stamped with monotonic time (ordering survives wall
+    clock jumps), wall time (human correlation) and the ambient trace
+    id when the transition happened inside a request. ``/ops/events``
+    serves the ring with ``since``/``kind`` filters, so "what did the
+    control plane just do and to whom" is one query instead of a log
+    dig.
+
+    Publishing is O(1): one lock, one deque append — safe to call from
+    breaker/dispatch hot paths. The ring holds the last ``keep``
+    events; ``published()`` counts lifetime publishes so a consumer
+    can detect it missed events that already rolled off.
+    """
+
+    def __init__(self, keep: int = 1024, *, enabled: bool = True,
+                 clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self.enabled = bool(enabled)
+        self._keep = max(1, int(keep))
+        self._ring: "collections.deque[dict]" = collections.deque(
+            maxlen=self._keep
+        )
+        self._seq = 0
+        self._published = 0
+
+    def configure(self, *, keep: int | None = None,
+                  enabled: bool | None = None) -> None:
+        """Apply config-tier settings to an already-constructed journal
+        (the process-global one is built at import from env defaults;
+        ObservabilityConfig re-applies through the app)."""
+        with self._lock:
+            if enabled is not None:
+                self.enabled = bool(enabled)
+            if keep is not None and max(1, int(keep)) != self._keep:
+                self._keep = max(1, int(keep))
+                self._ring = collections.deque(
+                    self._ring, maxlen=self._keep
+                )
+
+    def publish(self, kind: str, **data) -> int | None:
+        """Record one event; returns its sequence number (None when the
+        journal is disabled). ``data`` values must be JSON-safe — the
+        event is served verbatim at ``/ops/events``."""
+        if not self.enabled:
+            return None
+        evt: dict = {"kind": kind, "tMono": round(self._clock(), 6),
+                     "time": time.time()}
+        ctx = current_context()
+        if ctx is not None:
+            evt["traceId"] = ctx.trace_id
+        if data:
+            evt["data"] = data
+        with self._lock:
+            self._seq += 1
+            self._published += 1
+            evt["seq"] = self._seq
+            self._ring.append(evt)
+        return evt["seq"]
+
+    def events(self, *, since: int = 0, kind: str = "",
+               limit: int = 256) -> list[dict]:
+        """Events with seq > ``since``, newest last, optionally
+        filtered by kind (exact, or prefix: ``kind=breaker`` matches
+        ``breaker.open``), capped at the most recent ``limit``."""
+        with self._lock:
+            evs = [
+                dict(e)
+                for e in self._ring
+                if e["seq"] > since
+                and (
+                    not kind
+                    or e["kind"] == kind
+                    or e["kind"].startswith(kind + ".")
+                )
+            ]
+        limit = int(limit)
+        return evs[-limit:] if limit > 0 else []
+
+    def last_seq(self) -> int:
+        with self._lock:
+            return self._seq
+
+    def published(self) -> int:
+        with self._lock:
+            return self._published
+
+
+def _env_journal() -> EventJournal:
+    size = os.environ.get("BEACON_EVENT_JOURNAL_SIZE", "") or "1024"
+    enabled = os.environ.get(
+        "BEACON_EVENT_JOURNAL_ENABLED", ""
+    ).lower() not in ("0", "false", "no", "off")
+    try:
+        keep = int(size)
+    except ValueError:
+        keep = 1024
+    return EventJournal(keep=keep, enabled=enabled)
+
+
+#: the process flight recorder: control-plane sites publish here via
+#: :func:`publish_event`; ``/ops/events`` serves it. Process-global
+#: like ``profiler`` — breakers/routers live below the app layer and
+#: must not need a registry reference to be observable.
+journal = _env_journal()
+
+
+def publish_event(kind: str, **data) -> int | None:
+    """Publish one control-plane event to the process journal."""
+    return journal.publish(kind, **data)
 
 
 # -- profiling hooks ----------------------------------------------------------

@@ -251,6 +251,78 @@ def test_hedged_scan_not_gated_by_slow_worker():
         pool.close()
 
 
+# -- observability stays off the hot path (ISSUE 7) ---------------------------
+
+
+@pytest.mark.perf_smoke
+def test_observability_keeps_warm_path_contract():
+    """With the FULL observability surface armed — latency exemplars,
+    SLO burn-rate tracking, flight recorder, slow-query compare — a
+    warm repeated query through the API stays inside the existing
+    contract: ZERO device launches and millisecond-scale handling. The
+    instruments must explain the hot path, never tax it."""
+    import time
+
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.telemetry import journal
+
+    eng, _shards = _engine()
+    app = BeaconApp(engine=eng)
+    try:
+        assert journal.enabled  # flight recorder armed (default-on)
+        app.store.upsert(
+            "datasets",
+            [
+                {
+                    "id": f"d{d}",
+                    "name": f"d{d}",
+                    "_assemblyId": "GRCh38",
+                    "_vcfLocations": [f"v{d}"],
+                }
+                for d in range(N_SHARDS)
+            ],
+        )
+        eng.warmup()
+        body = {
+            "query": {
+                "requestedGranularity": "boolean",
+                "requestParameters": {
+                    "assemblyId": "GRCh38",
+                    "referenceName": "1",
+                    "start": [1],
+                    "end": [1 << 29],
+                    "alternateBases": "N",
+                },
+            }
+        }
+        status, first = app.handle("POST", "/g_variants", body=body)
+        assert status == 200  # prime the response/job caches
+        n0 = _launches()
+        times = []
+        for _ in range(100):
+            t0 = time.perf_counter()
+            status, out = app.handle("POST", "/g_variants", body=body)
+            times.append(time.perf_counter() - t0)
+            assert status == 200
+        assert _launches() - n0 == 0, "warm repeats touched the device"
+        times.sort()
+        p50_ms = times[len(times) // 2] * 1e3
+        # generous CI bound; the real number is sub-millisecond — the
+        # contract is "observability did not add a visible tax", not a
+        # benchmark claim (those live in bench.py)
+        assert p50_ms < 25.0, f"warm handle p50 {p50_ms:.2f} ms"
+        # the surfaces actually engaged: exemplars recorded, SLO
+        # counted the traffic
+        _, metrics = app.handle("GET", "/metrics")
+        assert "exemplars" in metrics["request"]["latency_ms"]["g_variants"]
+        _, slo = app.handle("GET", "/slo")
+        win = slo["routes"]["g_variants"]["availability"]["windows"]["5m"]
+        assert win["good"] >= 100 and win["burnRate"] == 0.0
+    finally:
+        app.close()
+        eng.close()
+
+
 @pytest.mark.perf_smoke
 def test_cache_disabled_still_fuses():
     """response_cache=False keeps the fused single-launch contract and

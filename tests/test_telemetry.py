@@ -87,14 +87,18 @@ def test_broken_callback_does_not_kill_render():
     assert "sbeacon_t_good 1" in reg.render_prometheus()
 
 
+_NUM = r"-?\d+(\.\d+)?([eE][+-]?\d+)?"
 _SAMPLE = re.compile(
-    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[^{}]*\})? -?\d+(\.\d+)?([eE][+-]?\d+)?$"
+    r"^[a-zA-Z_][a-zA-Z0-9_]*(\{[^{}]*\})? " + _NUM
+    # optional OpenMetrics exemplar: ` # {trace_id="..."} value [ts]`
+    + r"( # \{[^{}]*\} " + _NUM + r"( " + _NUM + r")?)?$"
 )
 
 
 def _assert_valid_exposition(text: str) -> dict:
     """Minimal Prometheus text-format parser: every non-comment line is
-    ``name{labels} value``; returns {metric_name: n_samples}."""
+    ``name{labels} value`` with an optional OpenMetrics exemplar
+    suffix; returns {metric_name: n_samples}."""
     seen: dict = {}
     for line in text.strip().splitlines():
         if not line or line.startswith("#"):
@@ -119,6 +123,22 @@ def test_prometheus_rendering_parses_with_histograms():
     )
     assert seen["sbeacon_req_lat_ms_sum"] == 2
     assert seen["sbeacon_req_lat_ms_count"] == 2
+
+
+@obs
+def test_openmetrics_counter_samples_get_total_suffix():
+    """OpenMetrics requires counter samples named <family>_total; the
+    classic format rejects that form — each dialect must render its
+    own naming, or a strict scraper fails the whole scrape."""
+    reg = MetricsRegistry()
+    reg.counter("t.hits", fn=lambda: 3)
+    reg.counter("t.by_route", label="route", fn=lambda: {"a": 1})
+    om = reg.render_prometheus(openmetrics=True)
+    assert "sbeacon_t_hits_total 3" in om
+    assert 'sbeacon_t_by_route_total{route="a"} 1' in om
+    assert "# TYPE sbeacon_t_hits counter" in om  # family keeps its name
+    classic = reg.render_prometheus()
+    assert "sbeacon_t_hits 3" in classic and "_total" not in classic
 
 
 # -- /metrics schema stability (golden keys) ----------------------------------
@@ -181,6 +201,12 @@ GOLDEN_METRICS = [
     "breaker.state",
     "breaker.consecutive_failures",
     "breaker.opens",
+    "batcher.stage_ms",
+    "runner.queue_wait_ms",
+    "slo.burn_rate",
+    "slo.latency_burn_rate",
+    "slo.breached",
+    "events.published",
 ]
 
 
@@ -242,10 +268,19 @@ def test_request_latency_histogram_per_route(app):
     app.handle("GET", "/info")
     app.handle("GET", "/map")
     app.handle("GET", "/does-not-exist")
+    # diagnostic heads only label their KNOWN endpoints: a scanner
+    # walking /ops/<random> must not mint histogram series
+    app.handle("GET", "/ops/scan-a")
+    app.handle("GET", "/debug/scan-b")
     _, body = app.handle("GET", "/metrics")
     lat = body["request"]["latency_ms"]
     assert "info" in lat and "map" in lat and "other" in lat
     assert lat["info"]["count"] >= 1
+    assert not any(
+        k.startswith(("ops.", "debug."))
+        and k not in ("ops.events", "debug.status")
+        for k in lat
+    ), sorted(lat)
     _, text = app.handle("GET", "/metrics", {"format": "prometheus"})
     assert 'sbeacon_request_latency_ms_bucket{route="info",le="+Inf"}' in text
 
@@ -373,6 +408,58 @@ def test_slow_query_fires_through_the_api(tmp_path):
         assert m["request"]["slow_queries"] >= 1
     finally:
         app.close()
+
+
+# -- error envelopes carry the trace id (ISSUE 7 satellite) -------------------
+
+
+@obs
+def test_error_envelopes_carry_trace_id(app):
+    """EVERY error envelope — 4xx and 5xx alike — must stamp
+    meta.traceId (and honor an inbound X-Beacon-Trace) exactly like the
+    happy path: a failed request is the one whose trace the operator
+    needs most."""
+    want = new_trace_id()
+    hdr = {"X-Beacon-Trace": want}
+
+    # 404 unknown path
+    status, body = app.handle("GET", "/no-such-path/x", None, None, hdr)
+    assert status == 404
+    assert body["meta"]["traceId"] == want
+    assert body["meta"]["elapsedTimeMs"] >= 0
+
+    # 400 malformed deadline header
+    status, body = app.handle(
+        "GET", "/g_variants", None, None,
+        {"X-Beacon-Trace": want, "X-Beacon-Deadline": "bogus"},
+    )
+    assert status == 400 and body["meta"]["traceId"] == want
+
+    # 429 admission shed
+    from sbeacon_tpu.resilience import AdmissionController
+
+    app.admission = AdmissionController(1)
+    assert app.admission.try_acquire()  # occupy the only slot
+    try:
+        status, body = app.handle("GET", "/g_variants", None, None, hdr)
+        assert status == 429, body
+        assert body["meta"]["traceId"] == want
+        assert body["retryAfterSeconds"] > 0
+    finally:
+        app.admission.release()
+
+    # 5xx: a store blow-up must still produce a trace-stamped envelope
+    def boom(*a, **kw):
+        raise RuntimeError("injected store failure")
+
+    app.store.filtering_terms = boom
+    status, body = app.handle("GET", "/filtering_terms", None, None, hdr)
+    assert status == 500 and body["meta"]["traceId"] == want
+
+    # error envelopes without an inbound id still mint one
+    status, body = app.handle("GET", "/no-such-path")
+    assert status == 404
+    assert re.fullmatch(r"[0-9a-f]{16}", body["meta"]["traceId"])
 
 
 # -- metric-name lint (CI wiring for tools/check_metric_names.py) -------------

@@ -11,7 +11,10 @@ form ``registry.counter("...")`` / ``reg.gauge("...")`` /
 - a name does not match the dotted-lowercase grammar the registry
   enforces at runtime (``batcher.launches``),
 - the same name is registered at two different source sites (two
-  producers fighting over one series).
+  producers fighting over one series),
+- the registered name set and the DEPLOYMENT.md metric catalogue (the
+  backticked dotted names between the ``metric-catalogue`` markers)
+  disagree in EITHER direction — docs cannot drift from code.
 
 Run directly (``python tools/check_metric_names.py``) or via the tier-1
 test ``tests/test_telemetry.py::test_metric_name_lint``.
@@ -24,6 +27,11 @@ import sys
 from pathlib import Path
 
 PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+DEPLOYMENT_MD = PKG.parent / "DEPLOYMENT.md"
+CATALOGUE_BEGIN = "<!-- metric-catalogue:begin -->"
+CATALOGUE_END = "<!-- metric-catalogue:end -->"
+#: a catalogue entry: a full dotted metric name in backticks
+BACKTICKED = re.compile(r"`([a-z0-9_.]+)`")
 
 #: a registration site: receiver named registry/reg, one of the three
 #: typed constructors, first argument a (possibly f-) string literal
@@ -77,16 +85,63 @@ def lint(registrations) -> list[str]:
     return errors
 
 
+def catalogue_names(path: Path = DEPLOYMENT_MD) -> set[str] | None:
+    """The documented metric catalogue: every backticked dotted name
+    between the catalogue markers in DEPLOYMENT.md, or None when the
+    marker block is missing (itself a lint failure)."""
+    try:
+        text = path.read_text()
+    except OSError:
+        return None
+    begin = text.find(CATALOGUE_BEGIN)
+    end = text.find(CATALOGUE_END)
+    if begin < 0 or end < begin:
+        return None
+    block = text[begin + len(CATALOGUE_BEGIN): end]
+    return {m for m in BACKTICKED.findall(block) if NAME.match(m)}
+
+
+def lint_catalogue(
+    registered: set[str], catalogue: set[str] | None
+) -> list[str]:
+    """Two-way parity between registrations and the DEPLOYMENT.md
+    catalogue: an undocumented series is invisible to operators, a
+    documented-but-gone series is a dashboard that silently flatlined."""
+    if catalogue is None:
+        return [
+            f"DEPLOYMENT.md: metric catalogue markers "
+            f"({CATALOGUE_BEGIN} ... {CATALOGUE_END}) not found — the "
+            "catalogue table must sit between them so this lint can "
+            "parse it"
+        ]
+    errors = []
+    for name in sorted(registered - catalogue):
+        errors.append(
+            f"metric {name!r} is registered but missing from the "
+            "DEPLOYMENT.md metric catalogue"
+        )
+    for name in sorted(catalogue - registered):
+        errors.append(
+            f"DEPLOYMENT.md catalogue documents {name!r} but no "
+            "registration exists under sbeacon_tpu/"
+        )
+    return errors
+
+
 def main() -> int:
     registrations = scan()
     errors = lint(registrations)
+    errors += lint_catalogue(
+        {r[0] for r in registrations}, catalogue_names()
+    )
     if errors:
         for e in errors:
             print(f"ERROR: {e}")
         return 1
     print(
         f"ok: {len(registrations)} instrument registrations, "
-        f"{len({r[0] for r in registrations})} unique names"
+        f"{len({r[0] for r in registrations})} unique names, "
+        "catalogue in sync"
     )
     return 0
 
