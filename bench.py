@@ -1482,6 +1482,181 @@ def config11_slo():
             app.close()
 
 
+def config12_tenants():
+    """Multi-tenant isolation probe (ISSUE 8): one tenant floods bulk
+    record queries at several times capacity while an interactive
+    tenant runs its normal traffic — the record carries per-tenant
+    p50/p99, shed counts, the adaptive Retry-After values advised, and
+    the brownout level reached (0 expected: overload alone, without an
+    SLO breach, must shape rather than brown out)."""
+    import random as _random
+    import tempfile
+    import threading
+    import time as _time
+    from pathlib import Path
+
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        ResilienceConfig,
+        ShapingConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.testing import random_records
+
+    rng = _random.Random(1200)
+    recs = random_records(rng, chrom="1", n=3000, n_samples=2)
+    with tempfile.TemporaryDirectory(prefix="bench-tenants-") as td:
+        cfg = BeaconConfig(
+            storage=StorageConfig(root=Path(td)),
+            engine=EngineConfig(
+                use_mesh=False,
+                microbatch=True,
+                device_planes=False,
+                response_cache=False,
+            ),
+            resilience=ResilienceConfig(max_in_flight=16),
+            shaping=ShapingConfig(
+                tenant_max_in_flight=1,
+                tenant_queue_depth=4,
+                max_queue_wait_s=2.5,
+                brownout=False,
+            ),
+        )
+        cfg.storage.ensure()
+        app = BeaconApp(cfg)
+        app.engine.add_index(
+            build_index(
+                recs,
+                dataset_id="tn0",
+                vcf_location="tn0.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+        app.store.upsert(
+            "datasets",
+            [
+                {
+                    "id": "tn0",
+                    "name": "tn0",
+                    "_assemblyId": "GRCh38",
+                    "_vcfLocations": ["synthetic://tn0"],
+                }
+            ],
+        )
+        app.engine.warmup()
+        pos = [int(r.pos) for r in recs]
+
+        def query(k: int, granularity: str):
+            p = pos[k % len(pos)]
+            return {
+                "query": {
+                    "requestedGranularity": granularity,
+                    "requestParameters": {
+                        "assemblyId": "GRCh38",
+                        "referenceName": "1",
+                        "start": [max(0, p - 1)],
+                        "end": [p + 1 + (k % 7)],
+                        "alternateBases": "N",
+                    },
+                }
+            }
+
+        orig_search = app.engine.search
+
+        def slow_bulk(pl):
+            # model a heavyweight retrieval so the bulk lane actually
+            # saturates its fair share (the synthetic shard answers in
+            # microseconds otherwise)
+            if pl.requested_granularity == "record":
+                _time.sleep(0.4)
+            return orig_search(pl)
+
+        app.engine.search = slow_bulk
+        try:
+            for k in range(10):  # warm
+                app.handle(
+                    "POST",
+                    "/g_variants",
+                    body=query(k, "boolean"),
+                    headers={"X-Beacon-Tenant": "gold"},
+                )
+            stop = threading.Event()
+            flood = {"shed": 0, "ok": 0, "retry_after": []}
+            lock = threading.Lock()
+
+            def flooder(fid: int):
+                k = 0
+                while not stop.is_set():
+                    k += 1
+                    s, b = app.handle(
+                        "POST",
+                        "/g_variants",
+                        body=query(fid * 977 + k, "record"),
+                        headers={"X-Beacon-Tenant": "flood"},
+                    )
+                    with lock:
+                        if s == 429:
+                            flood["shed"] += 1
+                            flood["retry_after"].append(
+                                b.get("retryAfterSeconds")
+                            )
+                        elif s == 200:
+                            flood["ok"] += 1
+                    if s == 429:
+                        _time.sleep(0.05)
+
+            flooders = [
+                threading.Thread(target=flooder, args=(i,), daemon=True)
+                for i in range(8)
+            ]
+            for t in flooders:
+                t.start()
+            _time.sleep(2.0)
+            lat, gold_shed = [], 0
+            for k in range(100):
+                t0 = _time.perf_counter()
+                s, _b = app.handle(
+                    "POST",
+                    "/g_variants",
+                    body=query(5000 + k, "boolean"),
+                    headers={"X-Beacon-Tenant": "gold"},
+                )
+                lat.append((_time.perf_counter() - t0) * 1e3)
+                if s == 429:
+                    gold_shed += 1
+            stop.set()
+            for t in flooders:
+                t.join(20)
+            # drain: the runner's pool threads persist results to the
+            # job table after the HTTP answer — closing under them
+            # logs spurious closed-database errors
+            t_end = _time.time() + 10
+            while _time.time() < t_end:
+                if app.query_runner.metrics()["active"] == 0:
+                    break
+                _time.sleep(0.05)
+            lat.sort()
+            shaping_doc = app.shaping.debug()
+            return {
+                "interactive_p50_ms": round(lat[len(lat) // 2], 3),
+                "interactive_p99_ms": round(
+                    lat[int(0.99 * (len(lat) - 1))], 3
+                ),
+                "interactive_shed": gold_shed,
+                "flood_ok": flood["ok"],
+                "flood_shed": flood["shed"],
+                "retry_after_min": min(flood["retry_after"], default=None),
+                "retry_after_max": max(flood["retry_after"], default=None),
+                "brownout_level": shaping_doc["brownoutLevel"],
+                "tenants": shaping_doc["tenants"],
+            }
+        finally:
+            app.close()
+
+
 _COLOCATED_SOAK_PROBE = """
 import jax
 jax.config.update("jax_platforms", "cpu")
@@ -1662,6 +1837,7 @@ def main() -> None:
     run("config9_soak", 120, lambda: config9_soak(shard, sindex))
     run("config10_fanout", 60, config10_fanout)
     run("config11_slo", 40, config11_slo)
+    run("config12_tenants", 40, config12_tenants)
     emit(final=True)
 
 

@@ -40,11 +40,13 @@ from ..resilience import (
     register_admission_metrics,
     register_breaker_metrics,
 )
+from ..shaping import TrafficShaper
 from ..slo import SloEngine
 from ..telemetry import (
     MetricsRegistry,
     RequestContext,
     SlowQueryLog,
+    annotate,
     journal,
     profiler,
     request_context,
@@ -215,6 +217,19 @@ class BeaconApp:
         self.admission = AdmissionController(
             res.max_in_flight, retry_after_s=res.shed_retry_after_s
         )
+        # traffic shaping (shaping.py): tenant-weighted fair queueing +
+        # priority lanes in FRONT of the global gate (a queued request
+        # holds no admission slot), with the brownout ladder fed by the
+        # SLO engine's breach signal below. The hedge kill-switch is
+        # process-wide, like the scan pools it governs.
+        def _hedge_control(enabled: bool) -> None:
+            from ..parallel.dispatch import set_hedging_enabled
+
+            set_hedging_enabled(enabled)
+
+        self.shaping = TrafficShaper.from_config(
+            self.config, hedge_control=_hedge_control
+        )
         # readiness flag: constructed apps are servable; a deployment
         # may clear it during reload/drain so load balancers back off
         self.ready = True
@@ -229,8 +244,11 @@ class BeaconApp:
         )
         # SLO engine (slo.py): per-route availability + latency
         # objectives evaluated as 5m/1h burn rates over every request
-        # outcome; served at /slo and as slo.* gauges
+        # outcome; served at /slo and as slo.* gauges. The brownout
+        # ladder subscribes to its breach signal: sustained burn steps
+        # degradation up, sustained recovery steps it back down.
         self.slo = SloEngine.from_config(obs)
+        self.slo.add_breach_listener(self.shaping.on_slo_signal)
         # flight recorder: the process journal was built from env
         # defaults at import; the config tier re-applies here (like
         # profiler.directory) so BEACON_EVENT_JOURNAL_* and explicit
@@ -276,6 +294,9 @@ class BeaconApp:
         separately when this app owns it."""
         self.query_runner.close()
         self.query_jobs.close()
+        shaper_close = getattr(self.shaping, "close", None)
+        if shaper_close is not None:
+            shaper_close()
 
     # -- telemetry wiring ---------------------------------------------------
 
@@ -305,6 +326,7 @@ class BeaconApp:
             fn=journal.published,
         )
         register_admission_metrics(reg, lambda: self.admission)
+        self.shaping.register_metrics(reg)
         self.query_runner.register_metrics(reg)
         engine_reg = getattr(self.engine, "register_metrics", None)
         if engine_reg is not None:
@@ -453,7 +475,18 @@ class BeaconApp:
                 if denied is not None:
                     return denied
                 deadline = self._request_deadline(head, headers)
-                with self.admission.admit(), deadline_scope(deadline):
+                # traffic shaping: classify tenant (header/API key/anon
+                # bucket) and priority lane (interactive boolean-count
+                # vs bulk record retrieval), then admit through the
+                # weighted fair queue BEFORE the global gate — a queued
+                # request holds no admission slot, and the deadline
+                # scope wraps the queue wait so it stays bounded
+                tenant = self.shaping.tenant_of(headers)
+                lane = self.shaping.lane_of(head, query_params, body)
+                annotate(tenant=tenant, lane=lane)
+                with deadline_scope(deadline), self.shaping.admit(
+                    tenant, lane
+                ), self.admission.admit():
                     return self._route(
                         method.upper(), path, query_params, body
                     )
@@ -461,7 +494,14 @@ class BeaconApp:
             # 429 shed / 503 batch-timeout & circuit-open / 504 deadline
             payload = self.env.error(e.status, str(e))
             if e.retry_after_s is not None:
-                payload["retryAfterSeconds"] = e.retry_after_s
+                # integer seconds, rounded up: the RFC 9110 Retry-After
+                # header only carries whole seconds, and the envelope
+                # field must say the SAME thing the header does (the
+                # transport derives the header from this field) — a
+                # sub-second adaptive value still advises >= 1 s
+                payload["retryAfterSeconds"] = max(
+                    1, math.ceil(e.retry_after_s)
+                )
             return e.status, payload
         except TimeoutError as e:
             return 504, self.env.error(504, str(e))
@@ -596,6 +636,7 @@ class BeaconApp:
         occ = batcher.occupancy() if batcher is not None else {}
         queues = {
             "admission": self.admission.metrics(),
+            "shaping": self.shaping.debug(),
             "runner": self.query_runner.metrics(),
             "batcher": {
                 k: occ[k] for k in ("launcher", "fetcher") if k in occ

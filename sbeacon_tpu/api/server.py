@@ -64,8 +64,11 @@ def _make_handler(app: BeaconApp):
                 else None
             )
             if retry_after is not None:
-                # standard client-backoff hint alongside the envelope
-                # field (integral seconds per RFC 9110, rounded up)
+                # standard client-backoff hint: the SAME value as the
+                # envelope's retryAfterSeconds — the app layer already
+                # normalized it to RFC 9110 integral seconds (rounded
+                # up), so the ceil here is a no-op guard for payloads
+                # minted outside BeaconApp.handle
                 self.send_header(
                     "Retry-After", str(max(1, math.ceil(retry_after)))
                 )

@@ -239,6 +239,10 @@ class ResilienceConfig:
     shed_retry_after_s: float = 1.0
     runner_workers: int = 8
     runner_max_pending: int = 64
+    # share of runner_max_pending the bulk lane may hold (lane-aware
+    # admission, shaping.py lanes): record-retrieval floods saturate at
+    # this fraction while interactive submissions keep the rest
+    runner_bulk_share: float = 0.5
     breaker_failure_threshold: int = 5
     breaker_reset_s: float = 30.0
     breaker_half_open_probes: int = 1
@@ -281,6 +285,60 @@ class TransportConfig:
     hedge_delay_s: float = 0.0
     bool_short_circuit: bool = True
     replica_hedge: bool = True
+
+
+@dataclasses.dataclass(frozen=True)
+class ShapingConfig:
+    """Traffic shaping & brownout (shaping.py) — the explicit version
+    of the reference's platform tier (API Gateway usage-plan throttling
+    + Lambda reserved concurrency): weighted fair queueing across
+    tenants, priority lanes, adaptive Retry-After, and an SLO-driven
+    brownout ladder.
+
+    enabled: the whole layer on/off (off restores the PR-1 global-gate
+      behaviour).
+    tenant_header: header carrying an explicit tenant id; requests
+      without it bucket by Authorization hash, else ``anon``.
+    tenant_weights: ``tenant=weight`` comma list for the DRR drain
+      ratio (``gold=4,free=1``); unlisted tenants get
+      ``default_weight``.
+    tenant_max_in_flight / tenant_queue_depth: per-tenant running cap
+      and per-tenant per-lane queue bound; a full queue sheds 429 with
+      the adaptive Retry-After.
+    max_queue_wait_s: a queued request not granted within this bound
+      sheds (its request deadline may cut earlier -> 504).
+    bulk_starvation_ms: a bulk waiter older than this is served ahead
+      of the interactive lane (one per dispatch pass) — the escape
+      hatch that keeps strict lane precedence from starving bulk.
+    retry_after_floor_s / retry_after_ceil_s: clamp on the adaptive
+      Retry-After (p90 of the shed lane's measured queue wait).
+    max_tenants: distinct tenant states (and metric label values)
+      tracked before new ids share the ``overflow`` bucket.
+    brownout*: the ladder — sustained SLO breach steps up
+      (hedge off -> bulk pause -> AIMD cap squeeze -> global shed)
+      after ``up_hold_s``; sustained recovery steps down after
+      ``down_hold_s`` (hysteresis), restoring squeezed caps by
+      ``ai_step`` per tick (additive increase over ``md_factor``
+      multiplicative decrease).
+    """
+
+    enabled: bool = True
+    tenant_header: str = "X-Beacon-Tenant"
+    tenant_weights: str = ""
+    default_weight: float = 1.0
+    tenant_max_in_flight: int = 64
+    tenant_queue_depth: int = 128
+    max_queue_wait_s: float = 10.0
+    bulk_starvation_ms: float = 500.0
+    retry_after_floor_s: float = 1.0
+    retry_after_ceil_s: float = 60.0
+    max_tenants: int = 64
+    brownout: bool = True
+    brownout_up_hold_s: float = 3.0
+    brownout_down_hold_s: float = 15.0
+    brownout_md_factor: float = 0.5
+    brownout_ai_step: float = 0.25
+    brownout_min_scale: float = 0.125
 
 
 @dataclasses.dataclass(frozen=True)
@@ -365,6 +423,9 @@ class BeaconConfig:
     )
     transport: TransportConfig = dataclasses.field(
         default_factory=TransportConfig
+    )
+    shaping: ShapingConfig = dataclasses.field(
+        default_factory=ShapingConfig
     )
 
     @staticmethod
@@ -461,6 +522,7 @@ class BeaconConfig:
             "BEACON_BREAKER_RESET_S": ("breaker_reset_s", float),
             "BEACON_BREAKER_PROBES": ("breaker_half_open_probes", int),
             "BEACON_FAILOVER_RETRIES": ("failover_retries", int),
+            "BEACON_RUNNER_BULK_SHARE": ("runner_bulk_share", float),
         }
         for var, (field, conv) in _res_env.items():
             if var in env:
@@ -512,6 +574,29 @@ class BeaconConfig:
                 env["BEACON_EVENT_JOURNAL_ENABLED"].lower() not in _off
             )
         observability = ObservabilityConfig(**obs_over)
+        sh_over: dict = {}
+        _sh_env = {
+            "BEACON_TENANT_HEADER": ("tenant_header", str),
+            "BEACON_TENANT_WEIGHTS": ("tenant_weights", str),
+            "BEACON_TENANT_DEFAULT_WEIGHT": ("default_weight", float),
+            "BEACON_TENANT_MAX_IN_FLIGHT": ("tenant_max_in_flight", int),
+            "BEACON_TENANT_QUEUE_DEPTH": ("tenant_queue_depth", int),
+            "BEACON_MAX_QUEUE_WAIT_S": ("max_queue_wait_s", float),
+            "BEACON_BULK_STARVATION_MS": ("bulk_starvation_ms", float),
+            "BEACON_RETRY_AFTER_FLOOR_S": ("retry_after_floor_s", float),
+            "BEACON_RETRY_AFTER_CEIL_S": ("retry_after_ceil_s", float),
+            "BEACON_MAX_TENANTS": ("max_tenants", int),
+            "BEACON_BROWNOUT_UP_S": ("brownout_up_hold_s", float),
+            "BEACON_BROWNOUT_DOWN_S": ("brownout_down_hold_s", float),
+        }
+        for var, (field, conv) in _sh_env.items():
+            if var in env:
+                sh_over[field] = conv(env[var])
+        if "BEACON_SHAPING" in env:
+            sh_over["enabled"] = env["BEACON_SHAPING"].lower() not in _off
+        if "BEACON_BROWNOUT" in env:
+            sh_over["brownout"] = env["BEACON_BROWNOUT"].lower() not in _off
+        shaping = ShapingConfig(**sh_over)
         return BeaconConfig(
             info=info,
             storage=storage,
@@ -522,6 +607,7 @@ class BeaconConfig:
             resilience=resilience,
             observability=observability,
             transport=transport,
+            shaping=shaping,
         )
 
     def dumps(self) -> str:

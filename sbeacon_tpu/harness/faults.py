@@ -23,6 +23,12 @@ Instrumented sites:
 - ``sqlite.commit`` — job-table persistence commits
   (``query_jobs.py``); ``latency`` here models the WAL-checkpoint
   fsync stalls the r5 soak chased.
+- ``admission.queue`` — the tenant fair-queue admission path
+  (``shaping.py FairQueueAdmission.acquire``); ``detail`` is
+  ``tenant:lane``, so a rule can target one tenant or lane with
+  ``match``. ``latency`` models a slow shaper (contended dispatch),
+  ``error`` fails admission outright — both hit BEFORE any slot is
+  taken, so no capacity leaks.
 
 Fault kinds: ``error`` raises :class:`FaultError`; ``latency`` sleeps
 ``ms``; ``hang`` sleeps ``ms`` too but defaults much longer — a hang is

@@ -83,6 +83,29 @@ from ..utils.trace import span
 log = logging.getLogger(__name__)
 
 
+# -- hedging kill-switch ------------------------------------------------------
+
+#: process-wide hedge enable flag: the brownout ladder's FIRST rung
+#: (shaping.BrownoutLadder via set_hedging_enabled) — under a sustained
+#: SLO breach the cheapest load to shed is the duplicate calls hedging
+#: adds, before any request is refused. Process-global like the fault
+#: injector: scan pools and replica routers live below the app layer.
+_hedging_enabled = True
+
+
+def set_hedging_enabled(enabled: bool) -> None:
+    """Flip the process-wide hedging kill-switch (brownout rung 1).
+    Affects the adaptive/fixed hedge delay computation in BOTH the
+    ingest scan pool and the replica-hedged search path; in-flight
+    hedges are unaffected."""
+    global _hedging_enabled
+    _hedging_enabled = bool(enabled)
+
+
+def hedging_enabled() -> bool:
+    return _hedging_enabled
+
+
 # -- worker side --------------------------------------------------------------
 
 
@@ -513,9 +536,10 @@ class ReplicaRouter:
     def hedge_delay(self, hedge_delay_s: float | None) -> float | None:
         """Seconds to wait before racing a second replica, with the
         scan-pool semantics unchanged: >0 fixed, 0 adaptive (p95 of
-        recent RTTs once enough samples exist), <0/None off."""
+        recent RTTs once enough samples exist), <0/None off. The
+        brownout kill-switch (``set_hedging_enabled``) overrides all."""
         d = hedge_delay_s
-        if d is None or d < 0:
+        if d is None or d < 0 or not _hedging_enabled:
             return None
         if d > 0:
             return d
@@ -681,7 +705,12 @@ class ScanWorkerPool:
         hedging is off (disabled, single worker, or adaptive mode
         without enough RTT history yet)."""
         d = self.hedge_delay_s
-        if d is None or d < 0 or len(self.worker_urls) < 2:
+        if (
+            d is None
+            or d < 0
+            or len(self.worker_urls) < 2
+            or not _hedging_enabled
+        ):
             return None
         if d > 0:
             return d

@@ -37,6 +37,7 @@ from pathlib import Path
 
 from concurrent.futures import ThreadPoolExecutor
 
+from .config import ResilienceConfig
 from .harness.faults import fault_point
 from .payloads import VariantSearchResponse
 from .resilience import (
@@ -463,14 +464,35 @@ class AsyncQueryRunner:
         )
         # explicit None checks, not `or`: a configured 0 must fail
         # loudly (ThreadPoolExecutor / AdmissionController raise), not
-        # silently coerce to the default
+        # silently coerce to the default. Fallback defaults read the
+        # ResilienceConfig field declarations — ONE source, so an env
+        # override (BEACON_SHED_RETRY_AFTER_S etc.) can never diverge
+        # between the server gate and this runner gate.
         if workers is None:
-            workers = getattr(res, "runner_workers", 8)
+            workers = getattr(
+                res, "runner_workers", ResilienceConfig.runner_workers
+            )
         if max_pending is None:
-            max_pending = getattr(res, "runner_max_pending", 64)
+            max_pending = getattr(
+                res, "runner_max_pending", ResilienceConfig.runner_max_pending
+            )
         self.workers = workers
         self.max_pending = max_pending
-        self.shed_retry_after_s = getattr(res, "shed_retry_after_s", 1.0)
+        self.shed_retry_after_s = getattr(
+            res, "shed_retry_after_s", ResilienceConfig.shed_retry_after_s
+        )
+        # lane-aware admission (shaping.py lanes): the bulk lane may
+        # hold at most this share of the pending slots, so a record-
+        # retrieval flood saturates its share while interactive
+        # submissions keep admitting
+        bulk_share = getattr(
+            res, "runner_bulk_share", ResilienceConfig.runner_bulk_share
+        )
+        self._bulk_cap = max(1, int(self.max_pending * bulk_share))
+        self._bulk_active = 0
+        # single-flight observability: identical in-flight queries
+        # collapsed onto a leader's pending result
+        self._coalesced = 0
         # bounded pool, NOT thread-per-query: a flood of distinct
         # queries used to spawn one unbounded thread each — under
         # adversarial load that is a fork bomb with extra steps. The
@@ -506,11 +528,16 @@ class AsyncQueryRunner:
 
     def metrics(self) -> dict:
         gate = self._gate.metrics()
+        with self._lock:
+            coalesced, bulk_active = self._coalesced, self._bulk_active
         return {
             "workers": self.workers,
             "max_pending": self.max_pending,
             "active": gate["in_flight"],
             "shed": gate["shed"],
+            "coalesced": coalesced,
+            "bulk_active": bulk_active,
+            "bulk_cap": self._bulk_cap,
         }
 
     def register_metrics(self, registry) -> None:
@@ -536,12 +563,32 @@ class AsyncQueryRunner:
             "runner submissions shed with 429",
             fn=lambda: self._gate.metrics()["shed"],
         )
+        registry.counter(
+            "runner.coalesced",
+            "identical in-flight queries collapsed onto a leader",
+            fn=lambda: self._coalesced,
+        )
+        registry.gauge(
+            "runner.bulk_active",
+            "bulk-lane submissions holding runner slots",
+            fn=lambda: self._bulk_active,
+        )
         # the admission-wait slice of the queue-wait decomposition
         # (/debug/status composes it ahead of the batcher stages)
         self._wait_hist = registry.histogram(
             "runner.queue_wait_ms",
             "async-runner submit -> execution-start wait",
         )
+
+    def _note_coalesced(self) -> None:
+        with self._lock:
+            self._coalesced += 1
+        annotate(query_job="coalesced")
+
+    def _release_bulk(self, bulk_slot: bool) -> None:
+        if bulk_slot:
+            with self._lock:
+                self._bulk_active -= 1
 
     def _note_queue_wait(self, wait_ms: float) -> None:
         with self._lock:
@@ -616,15 +663,36 @@ class AsyncQueryRunner:
             annotate(query_job="table_hit")
             return query_id, status
         if status is JobStatus.RUNNING:
-            # coalesce onto the in-flight execution — consumes no pool
-            # slot, so it must happen before the capacity gate
-            annotate(query_job="coalesced")
+            # single-flight: coalesce onto the in-flight execution —
+            # consumes no pool slot, so it must happen before the
+            # capacity gate (and before the bulk-lane cap: a follower
+            # attaches to the leader's pending result, it adds no work)
+            self._note_coalesced()
             return query_id, status
+        # lane-aware admission: the ambient lane note (set by the API
+        # layer's classifier) decides whether this submission draws
+        # from the bulk share of the pending slots
+        ctx = current_context()
+        lane = (ctx.notes.get("lane") if ctx is not None else None) or (
+            "interactive"
+        )
+        bulk_slot = False
+        if lane == "bulk":
+            with self._lock:
+                if self._bulk_active >= self._bulk_cap:
+                    raise Overloaded(
+                        f"query runner bulk lane at capacity "
+                        f"({self._bulk_cap} of {self.max_pending} slots)",
+                        retry_after_s=self.shed_retry_after_s,
+                    )
+                self._bulk_active += 1
+                bulk_slot = True
         # reserve a pool slot BEFORE claiming: shedding after a claim
         # would leave the job RUNNING with nobody executing it, stalling
         # coalesced waiters for the full TTL. Coalescing onto an
         # existing claim consumes no slot and is never shed.
         if not self._gate.try_acquire():
+            self._release_bulk(bulk_slot)
             raise Overloaded(
                 f"query runner at capacity ({self.max_pending} pending)",
                 retry_after_s=self.shed_retry_after_s,
@@ -636,10 +704,13 @@ class AsyncQueryRunner:
             # the reserved slot, or leaks accumulate until every
             # submit sheds 429 against an idle pool
             self._gate.release()
+            self._release_bulk(bulk_slot)
             raise
         if claim is None:
             # someone else holds an unexpired claim: coalesce
             self._gate.release()
+            self._release_bulk(bulk_slot)
+            self._note_coalesced()
             return query_id, JobStatus.RUNNING
 
         pl = dataclasses.replace(payload, query_id=query_id)
@@ -732,6 +803,7 @@ class AsyncQueryRunner:
                 finally:
                     done.set()
                     self._gate.release()
+                    self._release_bulk(bulk_slot)
                     with self._lock:
                         self._done.pop(query_id, None)
 
@@ -741,6 +813,7 @@ class AsyncQueryRunner:
             # pool shut down (close() raced a late submit): release
             # everything so the job doesn't read RUNNING forever
             self._gate.release()
+            self._release_bulk(bulk_slot)
             with self._lock:
                 self._done.pop(query_id, None)
             self.table.abandon(query_id, claim)

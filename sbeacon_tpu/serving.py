@@ -39,7 +39,12 @@ from .resilience import (
     DeadlineExceeded,
     current_deadline,
 )
-from .telemetry import annotate, percentiles, profile_region
+from .telemetry import (
+    annotate,
+    current_context,
+    percentiles,
+    profile_region,
+)
 from .utils.trace import span
 
 
@@ -62,6 +67,10 @@ class _Pending:
     #: request deadline alone — decides 504 (request's fault) vs 503
     #: (server-side wedge) when the combined bound expires
     req_deadline: Deadline = NO_DEADLINE
+    #: priority lane (shaping.classify_lane, read from the ambient
+    #: request context at submit): when the backlog exceeds one batch,
+    #: interactive entries ride the next launch ahead of bulk ones
+    lane: str = "interactive"
 
 
 class _Accumulator:
@@ -149,6 +158,11 @@ class MicroBatcher:
     ``max_wait_ms`` of quiet, or sooner as part of a fuller batch) and
     returns that query's row of the :class:`QueryResults`.
     """
+
+    #: a queued bulk entry older than this is no longer sorted behind
+    #: newly-arrived interactive entries — lane precedence must not
+    #: become starvation when the backlog stays above one batch
+    BULK_SORT_STARVATION_MS = 500.0
 
     def __init__(
         self,
@@ -284,6 +298,10 @@ class MicroBatcher:
         deadline = req_deadline.combine(
             timeout_s if timeout_s is not None else self.default_timeout_s
         )
+        ctx = current_context()
+        lane = (ctx.notes.get("lane") if ctx is not None else None) or (
+            "interactive"
+        )
         me = _Pending(
             specs=list(specs),
             shard_ids=None if shard_ids is None else list(shard_ids),
@@ -291,6 +309,7 @@ class MicroBatcher:
             t_submit=time.perf_counter(),
             deadline=deadline,
             req_deadline=req_deadline,
+            lane=lane,
         )
         with self._stats_lock:
             self._n_submits += 1
@@ -404,6 +423,36 @@ class MicroBatcher:
             batch: list[_Pending] = []
             try:
                 with acc.lock:
+                    # lane-ordered pop: when the queue holds both
+                    # lanes, interactive entries ride the next launch
+                    # ahead of bulk ones (stable within a lane, so
+                    # FIFO fairness survives). Only matters when the
+                    # backlog exceeds one batch — entries sharing a
+                    # launch share its latency regardless of order.
+                    # The leading request's own entry stays first (the
+                    # loop below assumes `me` rides the first pop).
+                    if len(acc.items) > 1:
+                        head = (
+                            1
+                            if me is not None and acc.items[0] is me
+                            else 0
+                        )
+                        tail = acc.items[head:]
+                        if any(p.lane == "bulk" for p in tail) and any(
+                            p.lane != "bulk" for p in tail
+                        ):
+                            # aged bulk entries keep their FIFO spot: a
+                            # steady interactive stream re-sorting every
+                            # pop must not displace an admitted bulk
+                            # entry until its deadline (the admission
+                            # queue's starvation escape, mirrored here)
+                            now_pc = time.perf_counter()
+                            exempt_s = self.BULK_SORT_STARVATION_MS / 1e3
+                            tail.sort(
+                                key=lambda p: p.lane == "bulk"
+                                and now_pc - p.t_submit < exempt_s
+                            )
+                            acc.items[head:] = tail
                     # cap by FLATTENED spec count, not submissions: a
                     # fused submit_many entry carries k specs, and a
                     # batch whose flattened size tops kernel.BATCH_TIERS

@@ -32,6 +32,8 @@ one lock, two ring-bucket increments — and sits on the request path.
 from __future__ import annotations
 
 import dataclasses
+import logging
+import math
 import threading
 import time
 
@@ -161,7 +163,15 @@ class SloEngine:
     ``snapshot`` renders the ``/slo`` document; ``register_metrics``
     exposes ``slo.burn_rate{route,window}`` (availability),
     ``slo.latency_burn_rate{route,window}`` and ``slo.breached{route}``
-    gauges in the app registry."""
+    gauges in the app registry. Breach *listeners*
+    (:meth:`add_breach_listener`) get the current breached-route list
+    at most once per ``NOTIFY_INTERVAL_S``, evaluated on the request
+    path after recording — the brownout ladder (shaping.py) subscribes
+    here, so degradation reacts to the same signal that pages."""
+
+    #: min seconds between breach-listener evaluations: the breach set
+    #: is O(routes x windows) to compute and must not run per request
+    NOTIFY_INTERVAL_S = 1.0
 
     def __init__(
         self,
@@ -182,6 +192,8 @@ class SloEngine:
         self._clock = clock
         self._lock = threading.Lock()
         self._route_states: dict[str, _RouteState] = {}
+        self._listeners: list = []
+        self._last_notify = -math.inf
         # routes with declared overrides exist from the start, so /slo
         # shows the objective (at zero traffic) instead of nothing
         for route, obj in self.overrides.items():
@@ -222,21 +234,50 @@ class SloEngine:
         non-5xx requests count (a failed request's latency is noise),
         bad when over the route's threshold. Route cardinality is
         bounded upstream by the API layer's route labeling."""
-        if not self.tracked(route):
+        if self.tracked(route):
+            ok = status < 500
+            with self._lock:
+                st = self._route_states.get(route)
+                if st is None:
+                    st = self._route_states[route] = _RouteState(
+                        self.overrides.get(route, self.default),
+                        self._horizon_s,
+                        self._bucket_s,
+                        self._clock,
+                    )
+                st.avail.record(ok)
+                if ok:
+                    st.latency.record(elapsed_ms <= st.objective.latency_ms)
+        # untracked routes still drive notification: health probes must
+        # keep the brownout ladder's recovery clock ticking even when
+        # shed 429s are the only tracked traffic
+        self._maybe_notify()
+
+    # -- breach listeners ----------------------------------------------------
+
+    def add_breach_listener(self, fn) -> None:
+        """``fn(breached_routes: list[str])`` called from the request
+        path, rate-limited to one evaluation per ``NOTIFY_INTERVAL_S``.
+        Listeners must be fast and must not raise (failures are logged
+        and swallowed — degradation control must never fail requests)."""
+        self._listeners.append(fn)
+
+    def _maybe_notify(self) -> None:
+        if not self._listeners:
             return
-        ok = status < 500
         with self._lock:
-            st = self._route_states.get(route)
-            if st is None:
-                st = self._route_states[route] = _RouteState(
-                    self.overrides.get(route, self.default),
-                    self._horizon_s,
-                    self._bucket_s,
-                    self._clock,
+            now = self._clock()
+            if now - self._last_notify < self.NOTIFY_INTERVAL_S:
+                return
+            self._last_notify = now
+        breached = self.breached_routes()
+        for fn in self._listeners:
+            try:
+                fn(breached)
+            except Exception:  # pragma: no cover - defensive
+                logging.getLogger(__name__).exception(
+                    "SLO breach listener failed"
                 )
-            st.avail.record(ok)
-            if ok:
-                st.latency.record(elapsed_ms <= st.objective.latency_ms)
 
     # -- evaluation ----------------------------------------------------------
 

@@ -328,8 +328,11 @@ def test_debug_status_schema_and_diagnosis(app):
         "stages", "events", "diagnosis",
     }
     assert doc["ready"] is True
-    assert set(doc["queues"]) == {"admission", "runner", "batcher"}
+    assert set(doc["queues"]) == {
+        "admission", "shaping", "runner", "batcher",
+    }
     assert doc["queues"]["admission"]["in_flight"] == 0
+    assert doc["queues"]["shaping"]["brownoutLevel"] == 0
     assert "materialize_ms" in doc["stages"]
     assert "admission_wait_ms" in doc["stages"]
     assert set(doc["diagnosis"]) == {
