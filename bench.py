@@ -1707,6 +1707,258 @@ with tempfile.TemporaryDirectory(prefix="co-soak-") as td:
 """
 
 
+def _pod_probe() -> dict:
+    """The pod-dispatch comparison body (ISSUE 9): the SAME k-shard
+    boolean + record query driven through the HTTP scatter (k worker
+    hosts, the reference's splitQuery topology) vs the pod-local mesh
+    tier (one compiled launch over the mesh-sharded fused index).
+    Records launches, worker HTTP calls saved, and p50/p99 per path."""
+    import random as _random
+
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ops import scatter_kernel
+    import sbeacon_tpu.ops.kernel as kernel_mod
+    from sbeacon_tpu.parallel import mesh as mesh_mod
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+    from sbeacon_tpu.parallel.transport import PooledTransport
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
+
+    n_shards = 4
+    n_queries = 60
+
+    def mkshard(d):
+        return build_index(
+            random_records(
+                _random.Random(1300 + d), chrom="1", n=4000, n_samples=2
+            ),
+            dataset_id=f"pod{d}",
+            vcf_location=f"pod{d}.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+
+    shards = [mkshard(d) for d in range(n_shards)]
+    datasets = [s.meta["dataset_id"] for s in shards]
+
+    def payload(gran, include):
+        # a bracket that matches a few hundred rows per shard: the
+        # device row path serves (no window/record overflow), so the
+        # record probe exercises the on-device hit-row GATHER, not the
+        # host-matcher fallback
+        return VariantQueryPayload(
+            dataset_ids=datasets,
+            reference_name="1",
+            start_min=1500,
+            start_max=2500,
+            end_min=1,
+            end_max=1 << 30,
+            alternate_bases="N",
+            requested_granularity=gran,
+            include_datasets=include,
+        )
+
+    def launches():
+        return (
+            kernel_mod.N_LAUNCHES
+            + scatter_kernel.N_DISPATCHES
+            + mesh_mod.N_LAUNCHES
+        )
+
+    def quantiles(engine, pay):
+        ts = []
+        for _ in range(n_queries):
+            t0 = time.perf_counter()
+            engine.search(pay)
+            ts.append((time.perf_counter() - t0) * 1e3)
+        ts.sort()
+        return (
+            round(ts[len(ts) // 2], 3),
+            round(ts[int(0.99 * (len(ts) - 1))], 3),
+        )
+
+    def concurrent_p50(engine, pay, n_clients=8, per=4):
+        """Per-query p50 under concurrent clients — the serving shape
+        where the micro-batcher amortises mesh launches across
+        requests."""
+        import threading
+        from concurrent.futures import ThreadPoolExecutor
+
+        ts: list = []
+        lock = threading.Lock()
+
+        def client(_i):
+            for _ in range(per):
+                t0 = time.perf_counter()
+                engine.search(pay)
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    ts.append(dt)
+
+        with ThreadPoolExecutor(n_clients) as pool:
+            list(pool.map(client, range(n_clients)))
+        ts.sort()
+        return round(ts[len(ts) // 2], 3)
+
+    out: dict = {"shards": n_shards, "queries_per_path": n_queries}
+    # -- HTTP scatter topology: one worker host per dataset shard ------------
+    workers = []
+    for s in shards:
+        weng = VariantEngine(
+            BeaconConfig(
+                engine=EngineConfig(
+                    microbatch=False, use_mesh=False, mesh_dispatch=False
+                )
+            )
+        )
+        weng.add_index(s)
+        workers.append(WorkerServer(weng).start_background())
+    transport = PooledTransport(pool_size=n_shards)
+    http = DistributedEngine(
+        [w.address for w in workers], transport=transport
+    )
+    try:
+        http.search(payload("count", "HIT"))  # warm + discovery
+        m0 = transport.metrics()
+        b50, b99 = quantiles(http, payload("boolean", "NONE"))
+        r50, r99 = quantiles(http, payload("record", "HIT"))
+        m1 = transport.metrics()
+        calls = (m1["opened"] + m1["reused"]) - (m0["opened"] + m0["reused"])
+        out["http"] = {
+            "boolean_p50_ms": b50,
+            "boolean_p99_ms": b99,
+            "record_p50_ms": r50,
+            "record_p99_ms": r99,
+            "worker_calls": calls,
+            "calls_per_query": round(calls / (2 * n_queries), 2),
+            "concurrent_p50_ms": concurrent_p50(
+                http, payload("boolean", "NONE")
+            ),
+        }
+    finally:
+        http.close()
+        for w in workers:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+    # -- pod-local mesh tier: same shards on the local device mesh -----------
+    eng = VariantEngine(
+        BeaconConfig(
+            engine=EngineConfig(use_mesh=False, microbatch_wait_ms=0.0)
+        )
+    )
+    for s in shards:
+        eng.add_index(s)
+    mesh = DistributedEngine([], local=eng)
+    try:
+        mesh.warmup()
+        n0 = launches()
+        mesh.search(payload("boolean", "NONE"))
+        out["single_launch"] = launches() - n0 == 1
+        n0 = launches()
+        b50, b99 = quantiles(mesh, payload("boolean", "NONE"))
+        r50, r99 = quantiles(mesh, payload("record", "HIT"))
+        n_mesh_launches = launches() - n0
+        conc50 = concurrent_p50(mesh, payload("boolean", "NONE"))
+        st = mesh.mesh_tier.stats()
+        occ = eng.batcher.occupancy() if eng.batcher is not None else {}
+        out["mesh"] = {
+            "boolean_p50_ms": b50,
+            "boolean_p99_ms": b99,
+            "record_p50_ms": r50,
+            "record_p99_ms": r99,
+            "concurrent_p50_ms": conc50,
+            "launches": n_mesh_launches,
+            "worker_calls": 0,
+            "dispatches": st["dispatches"],
+            "gather_rows": st["gather_rows"],
+            "devices": st["devices"],
+            "fallbacks": st["fallbacks"],
+            "batcher_mean_batch": occ.get("mean_batch", 0.0),
+        }
+    finally:
+        mesh.close()
+        eng.close()
+    out["rtts_saved_per_query"] = n_shards
+    out["mesh_p50_at_or_below_http"] = (
+        out["mesh"]["boolean_p50_ms"] <= out["http"]["boolean_p50_ms"]
+        and out["mesh"]["record_p50_ms"] <= out["http"]["record_p50_ms"]
+    )
+    import jax
+
+    if jax.default_backend() != "tpu":
+        # honesty flag for the CI shape: virtual CPU "devices" share
+        # the host cores, so the collective program pays n_dev-way
+        # SERIALISED compute per launch plus XLA's CPU collective
+        # dispatch overhead — wall-clock there measures the emulation,
+        # not the pod. The structural wins (1 launch, 0 worker RTTs,
+        # on-device gather) are topology-independent and asserted by
+        # the perf_smoke contract; on real multi-chip hardware the
+        # per-device work runs in parallel at device rate (BENCH r05:
+        # ~43M q/s device vs ~400k q/s pipelined — host coordination
+        # is the gap this tier removes).
+        out["note"] = (
+            "cpu-virtual-device mesh: latencies measure the n-way "
+            "serialised emulation, not pod hardware; see perf_smoke "
+            "contracts for the structural single-launch/zero-RTT wins"
+        )
+    return out
+
+
+def config13_pod():
+    """Pod-local SPMD dispatch probe. Runs inline when this process
+    already sees a multi-device mesh (a real pod); on a single-device
+    host the probe runs in a child process with a forced 8-virtual-CPU
+    mesh — the same shape CI tests the shard_map program under."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _pod_probe()
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        out_path = f.name
+    try:
+        code = (
+            "import json, sys, bench; "
+            "json.dump(bench._pod_probe(), open(sys.argv[1], 'w'))"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code, out_path],
+            env=env,
+            cwd=here,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=300,
+        )
+        if proc.returncode != 0:
+            return {
+                "error": "pod probe subprocess failed: "
+                + proc.stdout[-300:]
+            }
+        with open(out_path) as fh:
+            out = json.load(fh)
+        out["forced_cpu_devices"] = 8
+        return out
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -1838,6 +2090,7 @@ def main() -> None:
     run("config10_fanout", 60, config10_fanout)
     run("config11_slo", 40, config11_slo)
     run("config12_tenants", 40, config12_tenants)
+    run("config13_pod", 60, config13_pod)
     emit(final=True)
 
 

@@ -632,6 +632,11 @@ class BeaconApp:
                 "unavailableDatasets": engine.unavailable_datasets(),
                 "workers": engine.worker_stats(),
             }
+            # which dispatch tier serves pod-local dataset groups (and
+            # how often it has fallen back to the scatter)
+            tier = getattr(engine, "mesh_tier", None)
+            if tier is not None:
+                routing["meshTier"] = tier.stats()
         batcher = getattr(local, "_batcher", None)
         occ = batcher.occupancy() if batcher is not None else {}
         queues = {

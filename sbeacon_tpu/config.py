@@ -106,6 +106,15 @@ class EngineConfig:
     # dataset-sharded stack with psum fan-in (parallel/mesh.py) instead
     # of per-shard thread scatter; single-device falls back to scatter
     use_mesh: bool = True
+    # pod-local SPMD dispatch (parallel/dispatch.py MeshDispatchTier):
+    # a DistributedEngine with a local engine consults the tier per
+    # query — dataset groups resolvable on the local device mesh ride
+    # ONE compiled launch (mesh-sharded fused index, on-device fan-in
+    # + hit-row gather) instead of the thread/HTTP scatter.
+    # mesh_min_shards is the smallest per-query target count worth the
+    # mesh path (below it, per-shard dispatch is already one launch).
+    mesh_dispatch: bool = True
+    mesh_min_shards: int = 2
     ingest_shard_bytes: int = 64 * 1024 * 1024
     ingest_workers: int = 8
     max_response_inline_bytes: int = 300 * 1024  # performQuery spill threshold
@@ -462,6 +471,12 @@ class BeaconConfig:
                 "off",
             )
         _off = ("0", "false", "no", "off")
+        if "BEACON_MESH_DISPATCH" in env:
+            eng_over["mesh_dispatch"] = (
+                env["BEACON_MESH_DISPATCH"].lower() not in _off
+            )
+        if "BEACON_MESH_MIN_SHARDS" in env:
+            eng_over["mesh_min_shards"] = int(env["BEACON_MESH_MIN_SHARDS"])
         if "BEACON_FUSED_DISPATCH" in env:
             eng_over["fused_dispatch"] = (
                 env["BEACON_FUSED_DISPATCH"].lower() not in _off

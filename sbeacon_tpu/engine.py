@@ -955,6 +955,21 @@ class VariantEngine:
     def datasets(self) -> list[str]:
         return sorted({ds for ds, _ in self._indexes})
 
+    @property
+    def batcher(self):
+        """The serving micro-batcher (None when microbatch is off) —
+        the pod dispatch tier submits through it so cross-request
+        coalescing, the launch/fetch pipeline, and deadline-bounded
+        waits apply to mesh launches exactly as to per-shard ones."""
+        return self._batcher
+
+    def shard_snapshot(self) -> list[tuple[tuple[str, str], object]]:
+        """Sorted ``[((dataset_id, vcf_location), shard), ...]`` under
+        the publish lock — the pod dispatch tier builds its mesh stack
+        from this instead of iterating ``_indexes`` mid-ingest."""
+        with self._mesh_lock:
+            return [(k, v[0]) for k, v in sorted(self._indexes.items())]
+
     def index_fingerprint(self) -> str:
         """Identity of the loaded index set; folds into the response
         cache and async-query cache keys so cached results are

@@ -29,6 +29,10 @@ Instrumented sites:
   ``match``. ``latency`` models a slow shaper (contended dispatch),
   ``error`` fails admission outright — both hit BEFORE any slot is
   taken, so no capacity leaks.
+- ``mesh.dispatch`` — the pod-local mesh tier's single-launch path
+  (``parallel/dispatch.py MeshDispatchTier.search``); an ``error``
+  here exercises the fall-back-once-to-scatter contract
+  (``mesh.fallbacks`` counter + ``mesh.fallback`` journal event).
 
 Fault kinds: ``error`` raises :class:`FaultError`; ``latency`` sleeps
 ``ms``; ``hang`` sleeps ``ms`` too but defaults much longer — a hang is

@@ -60,6 +60,17 @@ def run_queries_auto(
             index, queries, window_cap=window_cap, record_cap=record_cap
         )
         return ReadyQueryResults(res) if async_fetch else res
+    # mesh-sharded fused index (parallel.mesh.MeshFusedIndex): duck-typed
+    # on its dispatch method so ops never imports parallel (no cycle) —
+    # the micro-batcher coalesces onto it exactly like a FusedDeviceIndex
+    mesh_run = getattr(index, "run_mesh_queries", None)
+    if mesh_run is not None:
+        return mesh_run(
+            queries,
+            window_cap=window_cap,
+            record_cap=record_cap,
+            async_fetch=async_fetch,
+        )
     return run_queries(
         index,
         queries,

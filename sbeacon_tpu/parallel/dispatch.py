@@ -421,6 +421,21 @@ def register_dispatch_metrics(registry, supplier) -> None:
         "background route-rediscovery passes run to heal dead routes",
         fn=field("rediscoveries"),
     )
+    registry.counter(
+        "mesh.dispatches",
+        "k-shard queries answered by the pod-local single-launch tier",
+        fn=field("mesh_dispatches"),
+    )
+    registry.counter(
+        "mesh.fallbacks",
+        "mesh-tier failures that fell back to the scatter path",
+        fn=field("mesh_fallbacks"),
+    )
+    registry.counter(
+        "mesh.gather_rows",
+        "hit rows gathered on-device by the mesh tier's row gather",
+        fn=field("mesh_gather_rows"),
+    )
 
 
 def _fingerprint_freshness(fp: str) -> int:
@@ -931,6 +946,312 @@ class WorkerError(RuntimeError):
     pass
 
 
+class MeshDispatchTier:
+    """Pod-local single-launch dispatch over a mesh-sharded fused index.
+
+    The reference answers a k-dataset query with a 500-thread Lambda
+    scatter and a DynamoDB counter fan-in; our HTTP tier mirrors that
+    shape — k RTTs — even when the k shards are chips in one pod. This
+    tier collapses that case: the local engine's shards stack into a
+    :class:`parallel.mesh.MeshFusedIndex` (dataset groups sharded over
+    ``jax.make_mesh`` with NamedSharding), and a query whose datasets
+    all live on the mesh costs ONE compiled launch — boolean OR,
+    count/allele psum, and the record-granularity hit-row gather all
+    inside the program (Pallas async-remote-copy ring on TPU,
+    all_gather elsewhere). Queries ride the local engine's
+    MicroBatcher (``submit_many``), so coalescing across concurrent
+    requests and the launch/fetch pipeline apply unchanged, and the
+    batcher's deadline-bounded waits keep the resilience contract.
+
+    The tier is an *optimisation* the :class:`DistributedEngine`
+    consults per query: dataset groups it cannot resolve (not built
+    yet, stale after an ingest, plane-reading granularities, fewer than
+    ``min_shards`` targets) keep the existing local/pooled-HTTP paths,
+    and a mesh-path failure falls back to the scatter once and trips
+    the ``mesh.fallbacks`` counter.
+    """
+
+    #: batch tiers pre-compiled by :meth:`warmup` (the serving batcher
+    #: pads k-spec submissions to kernel.BATCH_TIERS; a k<=8 fan-out —
+    #: the common pod query — must never pay a mid-request compile)
+    WARM_TIERS = (8, 64)
+
+    def __init__(
+        self,
+        engine,
+        *,
+        min_shards: int = 2,
+        axis: str = "d",
+        devices=None,
+    ):
+        self.engine = engine
+        self.min_shards = max(1, int(min_shards))
+        self.axis = axis
+        self._devices = devices
+        self._lock = threading.Lock()
+        # (MeshFusedIndex, {key: sid}, {key: shard}, {ds: [keys]}, fp)
+        self._state: tuple | None = None
+        self._building = False
+        # fingerprint a build pass declined (too few shards / build
+        # failure): don't spawn a rebuild thread per query for an
+        # index set that cannot produce a tier
+        self._skip_fp: str | None = None
+        self._dispatches = 0
+        self._fallbacks = 0
+        self._gather_rows = 0
+
+    # -- availability / build ----------------------------------------------
+
+    def available(self) -> bool:
+        """>=2 devices visible: a 1-device 'pod' would only re-spell the
+        fused single-device stack, which the engine already serves."""
+        try:
+            import jax
+
+            devs = self._devices if self._devices is not None else jax.devices()
+        except Exception:
+            return False
+        return len(devs) >= 2
+
+    def _snapshot(self):
+        """(keys, shards) the stack would build from, via the engine's
+        locked snapshot (never iterating ``_indexes`` mid-ingest)."""
+        snap = getattr(self.engine, "shard_snapshot", None)
+        if snap is None:
+            return [], []
+        pairs = snap()
+        return [k for k, _s in pairs], [s for _k, s in pairs]
+
+    def _ready(self, wait: bool = False):
+        """The current state, or None while unbuilt/stale (the caller
+        then keeps the scatter paths — freshness beats the mesh win).
+        A stale state arms a BACKGROUND rebuild; ``wait=True`` (warmup)
+        builds inline on the caller's clock."""
+        if not self.available():
+            return None
+        fp = self.engine.index_fingerprint()
+        while True:
+            with self._lock:
+                state = self._state
+                if state is not None and state[4] == fp:
+                    return state
+                if self._skip_fp == fp and not wait:
+                    return None
+                if not self._building:
+                    self._building = True
+                    break
+                if not wait:
+                    return None
+            # wait=True with a background build in flight: JOIN it
+            # instead of racing a duplicate full stack build (transient
+            # 2x device memory, doubled journal events), then re-check
+            time.sleep(0.05)
+        if wait:
+            return self._build(fp)
+        threading.Thread(
+            target=self._build, args=(fp,), name="mesh-tier-build",
+            daemon=True,
+        ).start()
+        return None
+
+    def _build(self, fp: str):
+        try:
+            from .mesh import MeshFusedIndex, make_mesh
+
+            keys, shards = self._snapshot()
+            if len(keys) < self.min_shards:
+                with self._lock:
+                    self._skip_fp = fp
+                return None
+            mesh = make_mesh(devices=self._devices, axis=self.axis)
+            index = MeshFusedIndex(shards, mesh, axis=self.axis)
+            sid_of = {k: i for i, k in enumerate(keys)}
+            shard_of = dict(zip(keys, shards))
+            keys_by_ds: dict[str, list] = {}
+            for k in keys:
+                keys_by_ds.setdefault(k[0], []).append(k)
+            state = (index, sid_of, shard_of, keys_by_ds, fp)
+            with self._lock:
+                self._state = state
+            publish_event(
+                "mesh.tier_ready",
+                shards=len(keys),
+                devices=index.n_dev,
+            )
+            log.info(
+                "mesh dispatch tier ready: %d shards over %d devices",
+                len(keys),
+                index.n_dev,
+            )
+            return state
+        except Exception:
+            log.exception("mesh dispatch tier build failed; scatter serves")
+            with self._lock:
+                self._skip_fp = fp
+            return None
+        finally:
+            with self._lock:
+                self._building = False
+
+    def warmup(self) -> int:
+        """Build inline and pre-compile the tier's batch-tier programs;
+        returns the program count (0 when the tier cannot engage)."""
+        state = self._ready(wait=True)
+        if state is None:
+            return 0
+        from ..ops.kernel import QuerySpec, encode_queries
+
+        index = state[0]
+        eng = self.engine.config.engine
+        n = 0
+        for t in self.WARM_TIERS:
+            index.run_mesh_queries(
+                encode_queries(
+                    [QuerySpec("1", 1, 1, 1, 2)] * t, shard_ids=[0] * t
+                ),
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+            )
+            n += 1
+        return n
+
+    # -- per-query consult ---------------------------------------------------
+
+    def resolve(self, dataset_ids, payload) -> set:
+        """The subset of ``dataset_ids`` this tier will serve for this
+        query — empty when the tier should not engage (unbuilt/stale
+        stack, plane-reading response shape, below ``min_shards``)."""
+        if not dataset_ids:
+            return set()
+        # plane-reading shapes (selected-samples leaf, sample-hit
+        # extraction) materialise through per-dataset genotype planes —
+        # those stay on the engine's existing paths. The predicate IS
+        # the engine's (_wants_planes), not a copy that could drift.
+        wants_planes = getattr(self.engine, "_wants_planes", None)
+        if payload.selected_samples_only or (
+            wants_planes is not None and wants_planes(payload)
+        ):
+            return set()
+        state = self._ready()
+        if state is None:
+            return set()
+        _index, _sid_of, _shard_of, keys_by_ds, _fp = state
+        covered = {ds for ds in dataset_ids if ds in keys_by_ds}
+        n_targets = sum(len(keys_by_ds[ds]) for ds in covered)
+        if n_targets < self.min_shards:
+            return set()
+        return covered
+
+    def search(
+        self, payload: VariantQueryPayload, dataset_ids
+    ) -> list[VariantSearchResponse]:
+        """Answer ``dataset_ids`` (a :meth:`resolve` result) with one
+        mesh launch. Raises on any failure — the caller owns the
+        fall-back-once-to-scatter contract."""
+        from ..engine import host_match_rows, materialize_response
+        from ..ops.kernel import QuerySpec, encode_queries
+
+        fault_point("mesh.dispatch")
+        deadline = current_deadline()
+        deadline.check("mesh.dispatch")
+        with self._lock:
+            state = self._state
+        if state is None:
+            raise WorkerError("mesh tier state gone")
+        index, sid_of, shard_of, keys_by_ds, _fp = state
+        spec_base = QuerySpec(
+            chrom=payload.reference_name,
+            start_min=payload.start_min,
+            start_max=payload.start_max,
+            end_min=payload.end_min,
+            end_max=payload.end_max,
+            reference_bases=payload.reference_bases,
+            alternate_bases=payload.alternate_bases,
+            variant_type=payload.variant_type,
+            variant_min_length=payload.variant_min_length,
+            variant_max_length=payload.variant_max_length,
+        )
+        targets = []
+        for ds in sorted(dataset_ids):
+            for key in keys_by_ds.get(ds, ()):
+                shard = shard_of[key]
+                native = shard.meta.get("chrom_native", {}).get(
+                    payload.reference_name
+                )
+                if native is None:
+                    continue  # no matching chromosome in this VCF
+                targets.append((key, shard, native, sid_of[key]))
+        if not targets:
+            return []
+        eng = self.engine.config.engine
+        specs = [spec_base] * len(targets)
+        sids = [sid for _k, _s, _n, sid in targets]
+        batcher = getattr(self.engine, "batcher", None)
+        if batcher is not None:
+            # the serving micro-batcher coalesces concurrent pod
+            # queries into the same launch and bounds the wait by the
+            # request deadline (the mesh wait IS deadline-scoped)
+            res = batcher.submit_many(
+                index,
+                specs,
+                shard_ids=sids,
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+            )
+        else:
+            fault_point("kernel.launch")
+            res = index.run_mesh_queries(
+                encode_queries(specs, shard_ids=sids),
+                window_cap=eng.window_cap,
+                record_cap=eng.record_cap,
+            )
+        responses = []
+        gathered = 0
+        for i, (key, shard, native, _sid) in enumerate(targets):
+            if res.overflow[i] or res.n_matched[i] > eng.record_cap:
+                # window/record overflow: uncapped host matcher, the
+                # same contract as every device kernel path
+                rows = host_match_rows(shard, spec_base)
+            else:
+                rows = res.rows[i][res.rows[i] >= 0]
+                gathered += int(rows.size)
+            responses.append(
+                materialize_response(
+                    shard,
+                    rows,
+                    payload,
+                    chrom_label=native,
+                    dataset_id=key[0],
+                    vcf_location=key[1],
+                )
+            )
+        with self._lock:
+            self._dispatches += 1
+            self._gather_rows += gathered
+        # the dispatch_tier note belongs to DistributedEngine.search —
+        # it knows whether this query was mesh-only or "mixed" with a
+        # scatter leg; writing it here would overwrite that label
+        annotate(mesh_shards=len(targets))
+        return responses
+
+    def note_fallback(self) -> None:
+        with self._lock:
+            self._fallbacks += 1
+
+    def stats(self) -> dict:
+        with self._lock:
+            state = self._state
+            out = {
+                "dispatches": self._dispatches,
+                "fallbacks": self._fallbacks,
+                "gather_rows": self._gather_rows,
+            }
+        out["ready"] = state is not None
+        out["shards"] = len(state[1]) if state is not None else 0
+        out["devices"] = state[0].n_dev if state is not None else 0
+        return out
+
+
 class DistributedEngine:
     """Coordinator: VariantEngine interface over remote workers (+ an
     optional local engine for locally-resident shards).
@@ -1059,6 +1380,19 @@ class DistributedEngine:
         self._pool = ThreadPoolExecutor(
             max_workers=max_threads, thread_name_prefix="dispatch"
         )
+        # pod-local mesh dispatch (consulted per query in search()):
+        # dataset groups resolvable on the local device mesh ride ONE
+        # compiled launch instead of the thread/HTTP scatter. Cheap to
+        # construct — device probing and the stack build are deferred
+        # to first use / warmup.
+        self.mesh_tier: MeshDispatchTier | None = None
+        eng_cfg = getattr(self.config, "engine", None)
+        if local is not None and getattr(eng_cfg, "mesh_dispatch", True):
+            self.mesh_tier = MeshDispatchTier(
+                local,
+                min_shards=getattr(eng_cfg, "mesh_min_shards", 2),
+                axis=getattr(eng_cfg, "mesh_axis", "d"),
+            )
 
     # headers are passed only when there is something to carry (a
     # configured token, an ambient trace id) AND the transport's
@@ -1093,7 +1427,10 @@ class DistributedEngine:
         program count — the coordinator deployment must not be the one
         shape the soak-tail fix skips."""
         warm = getattr(self.local, "warmup", None)
-        return warm() if warm else 0
+        n = warm() if warm else 0
+        if self.mesh_tier is not None:
+            n += self.mesh_tier.warmup()
+        return n
 
     def register_metrics(self, registry) -> None:
         """Coordinator telemetry: per-worker breaker series, the data
@@ -1118,6 +1455,9 @@ class DistributedEngine:
         """The fan-out counters behind the ``dispatch.*`` / ``routing.*``
         series (register_dispatch_metrics reads through this so a
         swapped engine stays observable)."""
+        mesh = (
+            self.mesh_tier.stats() if self.mesh_tier is not None else {}
+        )
         with self._sc_lock:
             return {
                 "short_circuits": self._short_circuits,
@@ -1125,6 +1465,9 @@ class DistributedEngine:
                 "partial_responses": self._partials,
                 "rediscoveries": self._rediscoveries,
                 "replicas": self.router.replica_count(),
+                "mesh_dispatches": mesh.get("dispatches", 0),
+                "mesh_fallbacks": mesh.get("fallbacks", 0),
+                "mesh_gather_rows": mesh.get("gather_rows", 0),
             }
 
     def route_table_age_s(self) -> float | None:
@@ -1656,9 +1999,25 @@ class DistributedEngine:
                 # it as unknown (a stale skip would be indistinguishable
                 # from 'no variants found')
                 table = self.replica_table(refresh=True)
+            # pod-local mesh consult: dataset groups resolvable on the
+            # local device mesh ride ONE compiled launch (below, on
+            # this thread, concurrent with the worker scatter) instead
+            # of the thread/HTTP scatter
+            mesh_ds: set = set()
+            tier = self.mesh_tier
+            if tier is not None:
+                try:
+                    mesh_ds = tier.resolve(
+                        [ds for ds in wanted if ds in local_ds], payload
+                    )
+                except Exception:
+                    log.exception("mesh tier resolve failed")
+                    mesh_ds = set()
             by_worker: dict[str, list[str]] = {}
             local_wanted: list[str] = []
             for ds in wanted:
+                if ds in mesh_ds:
+                    continue
                 if ds in local_ds:
                     local_wanted.append(ds)
                 elif ds in table:
@@ -1690,9 +2049,9 @@ class DistributedEngine:
             unavailable: list[str] = []
             group_err: Exception | None = None
             deadline = current_deadline()
+            ctx = current_context()
             futures: dict = {}
             if tasks:
-                ctx = current_context()
                 futures = {
                     self._pool.submit(
                         self._search_group, url, ds_list, payload,
@@ -1700,11 +2059,67 @@ class DistributedEngine:
                     ): url
                     for url, ds_list in tasks
                 }
+            # which tier is serving this query (the slow-query log's
+            # dispatch attribution)
+            if mesh_ds:
+                annotate(
+                    dispatch_tier=(
+                        "mesh" if not (tasks or local_wanted) else "mixed"
+                    )
+                )
+            elif tasks:
+                annotate(dispatch_tier="http")
+            elif local_wanted:
+                annotate(dispatch_tier="local")
+            # the POD-LOCAL mesh leg runs on this thread concurrently
+            # with the worker scatter: one compiled launch answers the
+            # whole local dataset group. A mesh failure falls back ONCE
+            # to the scatter planes (pooled HTTP where a worker route
+            # exists, the local engine's own dispatch otherwise) and
+            # trips mesh.fallbacks; a deadline expiry is the REQUEST's
+            # fault and never falls back (no time left to re-run).
+            first_err: BaseException | None = None
+            if mesh_ds:
+                try:
+                    responses.extend(tier.search(payload, mesh_ds))
+                except DeadlineExceeded as e:
+                    first_err = e
+                except Exception as e:
+                    tier.note_fallback()
+                    annotate(mesh_fallback=True)
+                    publish_event(
+                        "mesh.fallback",
+                        datasets=len(mesh_ds),
+                        error=type(e).__name__,
+                    )
+                    log.warning(
+                        "mesh tier failed for %d dataset(s); falling "
+                        "back to the scatter path (%s)",
+                        len(mesh_ds),
+                        e,
+                    )
+                    fb_by_worker: dict[str, list[str]] = {}
+                    for ds in sorted(mesh_ds):
+                        if ds in table:
+                            primary = self.router.pick(ds)
+                            if primary is not None:
+                                fb_by_worker.setdefault(
+                                    primary, []
+                                ).append(ds)
+                                continue
+                        if ds in local_ds:
+                            local_wanted.append(ds)
+                    for url, ds_list in sorted(fb_by_worker.items()):
+                        futures[
+                            self._pool.submit(
+                                self._search_group, url, ds_list,
+                                payload, deadline, ctx,
+                            )
+                        ] = url
             # the LOCAL shard search runs on this thread CONCURRENTLY
             # with the worker fan-out (it used to wait for the full
             # drain) — the coordinator's own datasets no longer sit
             # behind the slowest worker's RTT
-            first_err: BaseException | None = None
             if local_wanted:
                 try:
                     responses.extend(

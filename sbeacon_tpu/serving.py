@@ -352,9 +352,14 @@ class MicroBatcher:
             raise me.error
         # per-request stage note for the slow-query log: submit ->
         # result delivery (queue wait + device execute + fetch), the
-        # batcher's share of this request's latency
+        # batcher's share of this request's latency — plus the kernel
+        # family that served it (DeviceIndex / FusedDeviceIndex /
+        # ScatterDeviceIndex / MeshFusedIndex), so a tail is
+        # attributable to a dispatch tier without cross-referencing
+        # counters
         annotate(
-            batch_ms=round((time.perf_counter() - me.t_submit) * 1e3, 2)
+            batch_ms=round((time.perf_counter() - me.t_submit) * 1e3, 2),
+            batch_index=type(dindex).__name__,
         )
         return me.result
 

@@ -577,8 +577,10 @@ class EventJournal:
     """Bounded structured journal of control-plane transitions — the
     flight recorder. Breaker opens/closes, replica failovers, hedges,
     rediscovery passes, route-table publishes, cache invalidations,
-    fused-stack rebuilds and admission sheds each publish ONE small
-    event here, stamped with monotonic time (ordering survives wall
+    fused-stack rebuilds, mesh-tier bring-up/fallbacks
+    (``mesh.tier_ready`` / ``mesh.fallback``) and admission sheds each
+    publish ONE small event here, stamped with monotonic time (ordering
+    survives wall
     clock jumps), wall time (human correlation) and the ambient trace
     id when the transition happened inside a request. ``/ops/events``
     serves the ring with ``since``/``kind`` filters, so "what did the

@@ -1,0 +1,161 @@
+"""Subprocess body for the pod-dispatch single-launch contract.
+
+A pristine process (own XLA_FLAGS-forced device count, zero prior
+launches) builds a 4-shard local engine plus one HTTP worker, drives a
+k-shard boolean query through the mesh tier, and reports the contract
+observations as JSON: exactly ONE kernel launch across every kernel
+family, ZERO coordinator->worker HTTP calls (the pooled transport's
+process-wide stats unchanged), per-response parity with a plain
+engine, and the seeded-fault fallback path. The parent test
+(``test_mesh_dispatch.py::test_pod_contract_in_subprocess``) asserts
+the JSON.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+
+def main() -> None:
+    out_path = sys.argv[1]
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import random
+
+    import sbeacon_tpu.ops.kernel as kernel_mod
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.harness import faults
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ops import scatter_kernel
+    from sbeacon_tpu.parallel import mesh as mesh_mod
+    from sbeacon_tpu.parallel import transport as transport_mod
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
+
+    def launches() -> int:
+        return (
+            kernel_mod.N_LAUNCHES
+            + scatter_kernel.N_DISPATCHES
+            + mesh_mod.N_LAUNCHES
+        )
+
+    def shard(d: int, rows: int = 250):
+        rng = random.Random(40 + d)
+        return build_index(
+            random_records(rng, chrom="1", n=rows, n_samples=2),
+            dataset_id=f"d{d}",
+            vcf_location=f"v{d}",
+            sample_names=["S0", "S1"],
+        )
+
+    def engine(shards, **over):
+        eng = VariantEngine(
+            BeaconConfig(engine=EngineConfig(use_mesh=False, **over))
+        )
+        for s in shards:
+            eng.add_index(s)
+        return eng
+
+    n_shards = 4
+    eng = engine([shard(d) for d in range(n_shards)], microbatch_wait_ms=0.0)
+    # one real HTTP worker in the fleet: the contract is that the mesh
+    # query never touches it (its dataset is not in the query)
+    weng = engine([shard(9)], microbatch=False, mesh_dispatch=False)
+    worker = WorkerServer(weng).start_background()
+    dist = DistributedEngine([worker.address], local=eng)
+    ref = engine(
+        [shard(d) for d in range(n_shards)],
+        microbatch=False,
+        mesh_dispatch=False,
+    )
+
+    def payload(gran="boolean", include="NONE"):
+        return VariantQueryPayload(
+            dataset_ids=[f"d{d}" for d in range(n_shards)],
+            reference_name="1",
+            start_min=1,
+            start_max=1 << 29,
+            end_min=1,
+            end_max=1 << 30,
+            alternate_bases="N",
+            requested_granularity=gran,
+            include_datasets=include,
+        )
+
+    doc = {"devices": len(jax.devices())}
+    try:
+        dist.replica_table()  # discovery rides HTTP once, OUTSIDE the probe
+        dist.warmup()  # compiles outside the measured window
+
+        def transport_snapshot() -> dict:
+            keys = ("opened", "reused", "evicted", "retried", "gzip_bodies",
+                    "hedges")
+            return {k: transport_mod._STATS.get(k) for k in keys}
+
+        t0 = transport_snapshot()
+        n0 = launches()
+        m0 = mesh_mod.N_LAUNCHES
+        got = dist.search(payload())
+        doc["total_launches"] = launches() - n0
+        doc["mesh_launches"] = mesh_mod.N_LAUNCHES - m0
+        t1 = transport_snapshot()
+        doc["transport_stats_unchanged"] = t0 == t1
+        doc["worker_http_calls"] = (t1["opened"] + t1["reused"]) - (
+            t0["opened"] + t0["reused"]
+        )
+        st = dist.mesh_tier.stats()
+        doc["mesh_dispatches"] = st["dispatches"]
+        doc["exists"] = bool(got[0].exists) if got else None
+
+        # parity: count + record shapes against a plain engine
+        parity = True
+        for gran, include in [("count", "HIT"), ("record", "HIT")]:
+            a = [dataclasses.asdict(r) for r in dist.search(payload(gran, include))]
+            b = [dataclasses.asdict(r) for r in ref.search(payload(gran, include))]
+            parity = parity and a == b
+        doc["parity_ok"] = parity
+
+        # seeded fault: the mesh leg fails, the scatter answers, the
+        # fallback counter ticks once
+        faults.install(
+            {
+                "seed": 3,
+                "rules": [
+                    {"site": "mesh.dispatch", "kind": "error", "rate": 1.0}
+                ],
+            }
+        )
+        try:
+            got_fb = dist.search(payload("count", "HIT"))
+        finally:
+            faults.uninstall()
+        doc["fallback_ok"] = (
+            len(got_fb) == n_shards
+            and dist.mesh_tier.stats()["fallbacks"] == 1
+        )
+    finally:
+        dist.close()
+        worker.shutdown()
+        eng.close()
+        weng.close()
+        ref.close()
+
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh)
+    print("mesh tier worker OK", flush=True)
+
+
+if __name__ == "__main__":
+    main()

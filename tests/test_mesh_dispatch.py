@@ -1,0 +1,412 @@
+"""Pod-local SPMD dispatch (ISSUE 9): the mesh-sharded fused index and
+the MeshDispatchTier.
+
+The conftest forces an 8-virtual-CPU-device mesh, so the shard_map
+program runs in-process here exactly as the driver's dryrun does; every
+mesh test still skips cleanly when only one device is visible (running
+a file standalone without the conftest flags must not fail). The
+pristine-process single-launch contract additionally runs in a
+subprocess (``mesh_tier_worker.py``, the multihost_worker pattern) so
+its launch counters cannot be polluted by sibling tests.
+"""
+
+import dataclasses
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from sbeacon_tpu.config import BeaconConfig, EngineConfig
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.harness import faults
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.parallel import mesh as mesh_mod
+from sbeacon_tpu.parallel.dispatch import (
+    DistributedEngine,
+    MeshDispatchTier,
+    WorkerServer,
+)
+from sbeacon_tpu.parallel.mesh import MeshFusedIndex, make_mesh
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.resilience import Deadline, DeadlineExceeded, deadline_scope
+from sbeacon_tpu.testing import random_records
+
+multi_device = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="mesh dispatch needs >=2 devices (forced-host CI mesh)",
+)
+
+N_SHARDS = 4
+
+
+def _shards(n=N_SHARDS, chrom="1", rows=250):
+    out = []
+    for d in range(n):
+        rng = random.Random(40 + d)
+        recs = random_records(rng, chrom=chrom, n=rows, n_samples=2)
+        out.append(
+            build_index(
+                recs,
+                dataset_id=f"d{d}",
+                vcf_location=f"v{d}",
+                sample_names=["S0", "S1"],
+            )
+        )
+    return out
+
+
+def _engine(shards, **over):
+    eng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(use_mesh=False, **over))
+    )
+    for s in shards:
+        eng.add_index(s)
+    return eng
+
+
+def _payload(datasets, gran="count", include="HIT", **kw):
+    return VariantQueryPayload(
+        dataset_ids=list(datasets),
+        reference_name="1",
+        start_min=1,
+        start_max=1 << 29,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        requested_granularity=gran,
+        include_datasets=include,
+        **kw,
+    )
+
+
+# -- make_mesh device selection (satellite bugfix) ----------------------------
+
+
+def test_make_mesh_explicit_devices():
+    devs = jax.devices()
+    m = make_mesh(devices=devs[:1])
+    assert m.devices.size == 1
+    # explicit ordering is respected, not re-derived from jax.devices()
+    if len(devs) >= 2:
+        m2 = make_mesh(devices=[devs[1], devs[0]])
+        assert list(m2.devices.flat) == [devs[1], devs[0]]
+
+
+def test_make_mesh_zero_devices_is_loud():
+    with pytest.raises(ValueError, match="0 devices"):
+        make_mesh(devices=[])
+
+
+def test_make_mesh_too_many_devices_is_loud():
+    with pytest.raises(ValueError, match="only"):
+        make_mesh(n_devices=len(jax.devices()) + 1)
+
+
+# -- MeshFusedIndex: layout + single-launch program parity --------------------
+
+
+@multi_device
+def test_mesh_fused_index_parity_per_pair():
+    """Every (shard, query) pair answered by the sharded program must
+    match the single-shard kernel — including an uneven dataset count
+    (empty device groups) and dataset-LOCAL row ids."""
+    from sbeacon_tpu.ops.kernel import (
+        DeviceIndex,
+        QuerySpec,
+        encode_queries,
+        run_queries,
+    )
+
+    shards = _shards(5, chrom="7")
+    mesh = make_mesh()
+    mfi = MeshFusedIndex(shards, mesh)
+    specs = [
+        QuerySpec("7", 1, 1 << 30, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("7", 1500, 2500, 1, 1 << 30, alternate_bases="N"),
+    ]
+    pairs = [(sp, sid) for sp in specs for sid in range(5)]
+    enc = encode_queries(
+        [sp for sp, _ in pairs], shard_ids=[sid for _, sid in pairs]
+    )
+    res = mfi.run_mesh_queries(enc, window_cap=2048, record_cap=64)
+    for i, (spec, sid) in enumerate(pairs):
+        ref = run_queries(
+            DeviceIndex(shards[sid]), [spec], window_cap=2048, record_cap=64
+        )
+        assert res.exists[i] == ref.exists[0]
+        assert res.call_count[i] == ref.call_count[0]
+        assert res.all_alleles_count[i] == ref.all_alleles_count[0]
+        assert res.n_matched[i] == ref.n_matched[0]
+        assert res.overflow[i] == ref.overflow[0]
+        assert np.array_equal(
+            res.rows[i][res.rows[i] >= 0], ref.rows[0][ref.rows[0] >= 0]
+        )
+
+
+@multi_device
+def test_mesh_fused_index_requires_shard_ids():
+    shards = _shards(2)
+    mfi = MeshFusedIndex(shards, make_mesh())
+    enc = {"chrom": np.zeros(1, np.int32)}  # encoded without shard_ids
+    with pytest.raises(ValueError, match="shard ids"):
+        mfi.run_mesh_queries(enc, window_cap=2048, record_cap=64)
+
+
+# -- MeshDispatchTier through DistributedEngine -------------------------------
+
+
+@multi_device
+def test_tier_parity_across_granularities():
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    eng_ref = _engine(_shards(), microbatch=False, mesh_dispatch=False)
+    dist = DistributedEngine([], local=eng)
+    try:
+        assert dist.warmup() > 0
+        assert dist.mesh_tier is not None and dist.mesh_tier.stats()["ready"]
+        for gran, include in [
+            ("boolean", "NONE"),
+            ("count", "HIT"),
+            ("record", "HIT"),
+            ("aggregated", "ALL"),
+        ]:
+            pay = _payload([s.meta["dataset_id"] for s in shards], gran, include)
+            got = dist.search(pay)
+            ref = eng_ref.search(pay)
+            assert [dataclasses.asdict(r) for r in got] == [
+                dataclasses.asdict(r) for r in ref
+            ], (gran, include)
+        assert dist.mesh_tier.stats()["dispatches"] >= 3
+    finally:
+        dist.close()
+        eng.close()
+        eng_ref.close()
+
+
+@multi_device
+def test_tier_rides_microbatcher():
+    """The mesh launch goes through serving's MicroBatcher: a 4-target
+    query lands as one 4-spec submit_many entry (fused_hist key 4), so
+    coalescing/pipelining semantics apply to pod dispatch unchanged."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        dist.search(_payload([s.meta["dataset_id"] for s in shards]))
+        occ = eng.batcher.occupancy()
+        assert 4 in occ["fused_hist"] or "4" in occ["fused_hist"]
+        assert dist.mesh_tier.stats()["dispatches"] == 1
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+def test_tier_plane_shapes_stay_on_engine_paths():
+    """Selected-samples / sample-extraction shapes read genotype planes
+    per dataset — the tier must refuse them and the engine path serve."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        pay = _payload(
+            [s.meta["dataset_id"] for s in shards],
+            "record",
+            "ALL",
+            include_samples=True,
+        )
+        got = dist.search(pay)
+        assert len(got) == N_SHARDS
+        assert all(r.sample_names for r in got if r.exists)
+        assert dist.mesh_tier.stats()["dispatches"] == 0
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+def test_tier_goes_cold_on_ingest_then_rebuilds():
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        tier = dist.mesh_tier
+        pay = _payload([s.meta["dataset_id"] for s in shards])
+        dist.search(pay)
+        assert tier.stats()["dispatches"] == 1
+        # a publish bumps the fingerprint: the tier refuses to serve a
+        # stale stack (scatter answers) until the rebuild completes
+        extra = build_index(
+            random_records(random.Random(99), chrom="1", n=100, n_samples=2),
+            dataset_id="late",
+            vcf_location="late.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+        eng.add_index(extra)
+        got = dist.search(pay)  # stale stack refused; scatter answers
+        assert len(got) == N_SHARDS
+        assert tier.warmup() > 0  # inline rebuild picks up the new shard
+        assert tier.stats()["shards"] == N_SHARDS + 1
+        dist.search(_payload(["d0", "d1", "late"]))
+        # >= 2, not == 2: the background rebuild may have finished fast
+        # enough to serve the intermediate query too
+        assert tier.stats()["dispatches"] >= 2
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+@pytest.mark.resilience
+def test_tier_fallback_on_seeded_fault():
+    """A seeded mesh.dispatch fault must fall back ONCE to the scatter
+    path: the query still answers, mesh.fallbacks ticks, and the
+    flight recorder carries the mesh.fallback event."""
+    from sbeacon_tpu.telemetry import journal
+
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        seq0 = journal.last_seq()
+        faults.install(
+            {
+                "seed": 3,
+                "rules": [
+                    {"site": "mesh.dispatch", "kind": "error", "rate": 1.0}
+                ],
+            }
+        )
+        try:
+            got = dist.search(_payload([s.meta["dataset_id"] for s in shards]))
+        finally:
+            faults.uninstall()
+        assert len(got) == N_SHARDS and all(r.exists for r in got)
+        st = dist.mesh_tier.stats()
+        assert st["fallbacks"] == 1 and st["dispatches"] == 0
+        kinds = [e["kind"] for e in journal.events(since=seq0)]
+        assert "mesh.fallback" in kinds
+        # the fallback is once-per-query, not a latch: the next query
+        # rides the mesh tier again
+        got2 = dist.search(_payload([s.meta["dataset_id"] for s in shards]))
+        assert len(got2) == N_SHARDS
+        assert dist.mesh_tier.stats()["dispatches"] == 1
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+@pytest.mark.resilience
+def test_tier_deadline_expiry_never_falls_back():
+    """DeadlineExceeded is the REQUEST's fault: re-running the query on
+    the scatter would only burn more of nobody's time budget."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        with deadline_scope(Deadline.after(0.001)):
+            time.sleep(0.01)  # the deadline is certainly lapsed
+            with pytest.raises(DeadlineExceeded):
+                dist.search(_payload([s.meta["dataset_id"] for s in shards]))
+        assert dist.mesh_tier.stats()["fallbacks"] == 0
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+def test_tier_mixed_query_splits_mesh_and_http():
+    """Datasets on the local mesh ride the single launch; a dataset only
+    a worker serves keeps the pooled-HTTP scatter — one query, both
+    tiers, one merged response set."""
+    shards = _shards()
+    weng = _engine(
+        [
+            build_index(
+                random_records(random.Random(7), chrom="1", n=150, n_samples=2),
+                dataset_id="w0",
+                vcf_location="w0.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        ],
+        microbatch=False,
+        mesh_dispatch=False,
+    )
+    worker = WorkerServer(weng).start_background()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([worker.address], local=eng)
+    try:
+        dist.warmup()
+        got = dist.search(
+            _payload([s.meta["dataset_id"] for s in shards] + ["w0"])
+        )
+        assert [r.dataset_id for r in got] == ["d0", "d1", "d2", "d3", "w0"]
+        assert dist.mesh_tier.stats()["dispatches"] == 1
+    finally:
+        dist.close()
+        worker.shutdown()
+        eng.close()
+        weng.close()
+
+
+def test_tier_unavailable_on_single_device():
+    """With one visible device the tier must report unavailable and
+    resolve nothing — the engine's own paths already serve that case."""
+    shards = _shards(2)
+    eng = _engine(shards, microbatch=False)
+    try:
+        tier = MeshDispatchTier(eng, devices=jax.devices()[:1])
+        assert not tier.available()
+        assert tier.resolve(["d0", "d1"], _payload(["d0", "d1"])) == set()
+        assert tier.warmup() == 0
+    finally:
+        eng.close()
+
+
+# -- pristine-process single-launch contract (subprocess) ---------------------
+
+WORKER = Path(__file__).with_name("mesh_tier_worker.py")
+
+
+@pytest.mark.timeout(600)
+def test_pod_contract_in_subprocess(tmp_path):
+    """The satellite CPU-testability drive: a fresh process with
+    XLA_FLAGS-forced devices runs the full pod contract (1 launch, 0
+    worker HTTP calls, parity, fallback) with unpolluted counters."""
+    out = tmp_path / "out.json"
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    repo = str(WORKER.parent.parent)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, str(WORKER), str(out)],
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        cwd=repo,
+        timeout=540,
+    )
+    assert proc.returncode == 0, f"worker failed:\n{proc.stdout[-2000:]}"
+    doc = json.loads(out.read_text())
+    assert doc["devices"] >= 2
+    assert doc["mesh_launches"] == 1
+    assert doc["total_launches"] == 1
+    assert doc["worker_http_calls"] == 0
+    assert doc["transport_stats_unchanged"] is True
+    assert doc["mesh_dispatches"] == 1
+    assert doc["parity_ok"] is True
+    assert doc["fallback_ok"] is True

@@ -56,10 +56,16 @@ def _payload():
 
 
 def _launches() -> int:
-    # both kernel families count: XLA gather (CPU tier-1) + scatter tiles
+    # every kernel family counts: XLA gather (CPU tier-1), scatter
+    # tiles, and the pod-local mesh programs
     from sbeacon_tpu.ops import scatter_kernel
+    from sbeacon_tpu.parallel import mesh as mesh_mod
 
-    return kernel_mod.N_LAUNCHES + scatter_kernel.N_DISPATCHES
+    return (
+        kernel_mod.N_LAUNCHES
+        + scatter_kernel.N_DISPATCHES
+        + mesh_mod.N_LAUNCHES
+    )
 
 
 @pytest.mark.perf_smoke
@@ -249,6 +255,66 @@ def test_hedged_scan_not_gated_by_slow_worker():
         assert stats["hedges"] == 1 and stats["hedge_wins"] == 1
     finally:
         pool.close()
+
+
+# -- pod-local mesh dispatch (ISSUE 9) ----------------------------------------
+
+
+@pytest.mark.perf_smoke
+def test_mesh_tier_boolean_query_is_one_launch_zero_http():
+    """A 4-shard boolean query served by the pod-local mesh tier must
+    cost exactly ONE kernel launch and ZERO coordinator->worker HTTP
+    calls (the pooled transport's process-wide stats unchanged across
+    the query) — the reference shape was k Lambda RTTs plus a DynamoDB
+    counter poll."""
+    import jax
+
+    from sbeacon_tpu.parallel import transport as transport_mod
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.testing import random_records
+
+    if len(jax.devices()) < 2:
+        pytest.skip("mesh tier needs >=2 devices (forced-host CI mesh)")
+    eng, _shards = _engine()
+    # a live worker in the fleet proves "zero HTTP" is the tier's doing,
+    # not an empty topology
+    weng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    weng.add_index(
+        build_index(
+            random_records(random.Random(9), chrom="1", n=120, n_samples=2),
+            dataset_id="wrk",
+            vcf_location="wrk.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+    )
+    worker = WorkerServer(weng).start_background()
+    dist = DistributedEngine([worker.address], local=eng)
+
+    def transport_snapshot() -> dict:
+        keys = ("opened", "reused", "evicted", "retried", "gzip_bodies")
+        return {k: transport_mod._STATS.get(k) for k in keys}
+
+    try:
+        dist.replica_table()  # discovery rides HTTP, OUTSIDE the probe
+        dist.warmup()  # compiles outside the measured window
+        t0 = transport_snapshot()
+        n0 = _launches()
+        got = dist.search(
+            _worker_payload(datasets=[f"d{d}" for d in range(N_SHARDS)])
+        )
+        assert _launches() - n0 == 1, "expected exactly one mesh launch"
+        assert transport_snapshot() == t0, "mesh query touched the transport"
+        assert any(r.exists for r in got) or got == []
+        st = dist.mesh_tier.stats()
+        assert st["dispatches"] == 1 and st["fallbacks"] == 0
+    finally:
+        dist.close()
+        worker.shutdown()
+        weng.close()
+        eng.close()
 
 
 # -- observability stays off the hot path (ISSUE 7) ---------------------------

@@ -340,6 +340,8 @@ def test_engine_warmup_compiles_all_paths():
     # repeat is cheap and idempotent
     assert eng.warmup() == n
     dist = DistributedEngine([], local=eng)
-    assert dist.warmup() == n
+    # the local engine's programs plus the pod mesh tier's own batch
+    # tiers (when >=2 devices are visible the tier warms too)
+    assert dist.warmup() >= n
     dist.close()
     eng.close()

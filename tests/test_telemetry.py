@@ -198,6 +198,9 @@ GOLDEN_METRICS = [
     "dispatch.partial_responses",
     "routing.replicas",
     "routing.rediscoveries",
+    "mesh.dispatches",
+    "mesh.fallbacks",
+    "mesh.gather_rows",
     "breaker.state",
     "breaker.consecutive_failures",
     "breaker.opens",
