@@ -1959,6 +1959,247 @@ def config13_pod():
             pass
 
 
+def config14_ingest_serve():
+    """Ingest-while-serving soak (ISSUE 10): continuous small-VCF
+    submissions stream delta shards into a serving engine (base publish
+    deferred to the compactor) while a query thread hammers the warm
+    plane. Records freshness lag (submit -> first hit), warm-query
+    p50/p99 during ingest vs idle, response-cache hit-rate across
+    publishes (scoped invalidation must NOT reset it), and slice-stage
+    rec/s scaling at 1/2/4 pipeline workers."""
+    import random as _random
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    import numpy as _np
+
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        IngestConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.genomics.tabix import ensure_index
+    from sbeacon_tpu.genomics.vcf import VcfRecord, write_vcf
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ingest.ledger import JobLedger
+    from sbeacon_tpu.ingest.pipeline import (
+        SLICE_DISK,
+        SummarisationPipeline,
+    )
+    from sbeacon_tpu.ingest.service import DeltaCompactor
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
+
+    samples = ["S0", "S1"]
+
+    def _rec(chrom, pos):
+        return VcfRecord(chrom=chrom, pos=pos, ref="A", alts=["T"],
+                         ac=[1], an=4, vt="SNP",
+                         genotypes=["0|1", "0|0"])
+
+    def _q(chrom, lo, hi, gran="count"):
+        return VariantQueryPayload(
+            dataset_ids=[], reference_name=chrom, start_min=lo,
+            start_max=hi, end_min=lo, end_max=hi + 64,
+            alternate_bases="N", requested_granularity=gran,
+            include_datasets="HIT",
+        )
+
+    out: dict = {}
+    with tempfile.TemporaryDirectory(prefix="bench-ingserve-") as td:
+        root = Path(td)
+        cfg = BeaconConfig(
+            storage=StorageConfig(root=root / "store"),
+            engine=EngineConfig(use_mesh=False),
+            ingest=IngestConfig(
+                workers=2,
+                stream_deltas=True,
+                defer_base_publish=True,
+                compact_interval_s=0.0,  # fold only when we say so
+                delta_max_shards=1_000_000,
+                export_portable=False,
+            ),
+        )
+        cfg.storage.ensure()
+        eng = VariantEngine(cfg)
+        rng = _random.Random(7)
+        eng.add_index(build_index(
+            random_records(rng, chrom="1", n=4000, n_samples=2),
+            dataset_id="base", vcf_location="base.vcf",
+            sample_names=samples,
+        ))
+        pipe = SummarisationPipeline(cfg, ledger=JobLedger(), engine=eng)
+        comp = DeltaCompactor(eng, pipe, pipe.ledger, cfg)
+
+        # warm query set over the BASE dataset (repeats -> cache hits)
+        warm = [_q("1", 1000 + 97 * k, 1400 + 97 * k) for k in range(16)]
+        for q in warm:
+            eng.search(q)
+
+        def _measure(n_rounds):
+            lat = []
+            for _ in range(n_rounds):
+                for q in warm:
+                    t0 = time.perf_counter()
+                    eng.search(q)
+                    lat.append((time.perf_counter() - t0) * 1e3)
+            a = _np.asarray(lat)
+            return {
+                "p50_ms": round(float(_np.percentile(a, 50)), 3),
+                "p99_ms": round(float(_np.percentile(a, 99)), 3),
+            }
+
+        idle = _measure(40)
+
+        # -- continuous ingest soak ---------------------------------------
+        lags = []
+        lat_during: list = []
+        stop = threading.Event()
+
+        def querier():
+            while not stop.is_set():
+                for q in warm:
+                    t0 = time.perf_counter()
+                    eng.search(q)
+                    lat_during.append(
+                        (time.perf_counter() - t0) * 1e3
+                    )
+                # paced load: measure latency, don't saturate the GIL
+                time.sleep(0.001)
+
+        qt = threading.Thread(target=querier, daemon=True)
+        hits0 = eng.cache_stats()["hits"]
+        miss0 = eng.cache_stats()["misses"]
+        qt.start()
+        n_submits = 8
+        try:
+            for k in range(n_submits):
+                chrom = "2"
+                pos = 10_000 + 1000 * k
+                vcf = root / f"sub{k}.vcf.gz"
+                write_vcf(
+                    vcf,
+                    [_rec(chrom, pos + j) for j in range(25)],
+                    sample_names=samples,
+                )
+                ensure_index(vcf)
+                probe = _q(chrom, pos, pos + 30, gran="boolean")
+                t0 = time.perf_counter()
+                sub = threading.Thread(
+                    target=pipe.summarise_dataset,
+                    args=(f"sub{k}", [str(vcf)]),
+                )
+                sub.start()
+                # read-your-writes: the sentinel answers as soon as its
+                # slice's DELTA publishes — before the submit thread is
+                # done with stats/ledger, and long before any fold
+                while not any(
+                    r.exists for r in eng.search(probe)
+                ):
+                    if time.perf_counter() - t0 > 10:
+                        break
+                    time.sleep(0.002)
+                lags.append(time.perf_counter() - t0)
+                sub.join(timeout=30)
+        finally:
+            stop.set()
+            qt.join(timeout=10)
+        stats = eng.cache_stats()
+        d_hits = stats["hits"] - hits0
+        d_miss = stats["misses"] - miss0
+        during = (
+            _np.asarray(lat_during) if lat_during else _np.zeros(1)
+        )
+        p99_idle = max(idle["p99_ms"], 1e-6)
+        p99_during = round(float(_np.percentile(during, 99)), 3)
+        out["soak"] = {
+            "submits": n_submits,
+            "freshness_lag_s": {
+                "max": round(max(lags), 3),
+                "mean": round(sum(lags) / len(lags), 3),
+            },
+            "read_your_writes_under_1s": bool(max(lags) < 1.0),
+            "idle": idle,
+            "during_ingest": {
+                "p50_ms": round(float(_np.percentile(during, 50)), 3),
+                "p99_ms": p99_during,
+                "queries": int(len(lat_during)),
+            },
+            "p99_ratio_vs_idle": round(p99_during / p99_idle, 2),
+            # acceptance bound: <= 2x idle, with a 1 ms absolute floor
+            # (at tens-of-microseconds cache-hit latencies the ratio is
+            # GIL noise, not serving degradation)
+            "p99_within_2x_idle_or_1ms": bool(
+                p99_during <= max(2 * p99_idle, 1.0)
+            ),
+            "cache_hit_rate_across_publishes": round(
+                d_hits / max(1, d_hits + d_miss), 4
+            ),
+            "delta_tail": eng.delta_stats(),
+            "scoped_invalidations": stats["scoped_invalidations"],
+        }
+        # -- fold everything and verify the plane survives ----------------
+        t0 = time.perf_counter()
+        folded = comp.run_once()
+        out["compaction"] = {
+            "keys_folded": len(folded),
+            "rows_folded": int(sum(folded.values())),
+            "wall_s": round(time.perf_counter() - t0, 2),
+            "tail_after": eng.delta_stats(),
+            "ledger": pipe.ledger.delta_summary(),
+        }
+        out["slice_disk"] = SLICE_DISK.stats()
+        eng.close()
+
+        # -- slice-stage worker scaling -----------------------------------
+        scaling = {}
+        recs = []
+        for chrom in ("3", "4", "5", "6"):
+            recs.extend(
+                random_records(
+                    _random.Random(50), chrom=chrom, n=4000,
+                    n_samples=8,
+                )
+            )
+        big = root / "scale.vcf.gz"
+        write_vcf(
+            big, recs, sample_names=[f"W{i}" for i in range(8)]
+        )
+        ensure_index(big)
+        for workers in (1, 2, 4):
+            wcfg = BeaconConfig(
+                storage=StorageConfig(root=root / f"scale-w{workers}"),
+                ingest=IngestConfig(
+                    workers=workers,
+                    min_task_time=1e-4,
+                    scan_rate=2e6,
+                    dispatch_cost=1e-6,
+                    max_concurrency=64,
+                ),
+            )
+            wcfg.storage.ensure()
+            wpipe = SummarisationPipeline(wcfg, ledger=JobLedger())
+            t0 = time.perf_counter()
+            shard = wpipe.summarise_vcf("scale", str(big))
+            dt = time.perf_counter() - t0
+            scaling[str(workers)] = {
+                "rec_per_s": round(len(recs) / dt, 1),
+                "wall_s": round(dt, 2),
+                "rows": shard.n_rows,
+            }
+        out["worker_scaling"] = scaling
+        out["worker_scaling_note"] = (
+            "pure-python parse on a shared-CPU box is GIL-bound; the "
+            "fan-out contract (per-slice tasks over the planner) is "
+            "the structural claim — native tokenizer + real cores "
+            "scale it (see INGEST manifests)"
+        )
+    return out
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -2091,6 +2332,7 @@ def main() -> None:
     run("config11_slo", 40, config11_slo)
     run("config12_tenants", 40, config12_tenants)
     run("config13_pod", 60, config13_pod)
+    run("config14_ingest_serve", 90, config14_ingest_serve)
     emit(final=True)
 
 

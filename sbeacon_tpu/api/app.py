@@ -297,6 +297,9 @@ class BeaconApp:
         shaper_close = getattr(self.shaping, "close", None)
         if shaper_close is not None:
             shaper_close()
+        ingest_close = getattr(self.ingest, "close", None)
+        if ingest_close is not None:
+            ingest_close()
 
     # -- telemetry wiring ---------------------------------------------------
 
@@ -350,6 +353,27 @@ class BeaconApp:
                 reg,
                 lambda: getattr(self.engine, "dispatch_stats", dict)(),
             )
+        if "ingest.delta_publishes" not in reg.names():
+            # local-less coordinators have no delta registry; zeros
+            from ..engine import register_delta_metrics
+
+            register_delta_metrics(
+                reg,
+                lambda: getattr(
+                    getattr(self.engine, "local", None) or self.engine,
+                    "delta_metrics",
+                    dict,
+                )(),
+            )
+        # compaction + slice-disk series (ingest-while-serving plane)
+        from ..ingest.pipeline import register_ingest_metrics
+        from ..ingest.service import register_compaction_metrics
+
+        register_ingest_metrics(reg)
+        register_compaction_metrics(
+            reg,
+            lambda: getattr(self.ingest, "compaction_metrics", dict)(),
+        )
 
     #: bounded route-label set for the latency histogram — unknown
     #: paths collapse to "other" so a URL scanner cannot mint series
@@ -656,6 +680,17 @@ class BeaconApp:
         st = getattr(local, "stage_timing", None)
         if st is not None:
             stages.update(st())
+        # ingest-while-serving rollup: per-dataset delta-tail depth
+        # (rows queryable but not yet folded) + compactor counters —
+        # "how stale is the base, and is the fold keeping up" in one
+        # glance
+        ingest: dict = {}
+        delta_stats = getattr(local, "delta_stats", None)
+        if delta_stats is not None:
+            ingest["deltaTails"] = delta_stats()
+        compactor = getattr(self.ingest, "compactor", None)
+        if compactor is not None:
+            ingest["compactor"] = compactor.metrics()
         slo = self.slo.snapshot()
         breached = sorted(
             r for r, doc in slo["routes"].items() if doc["breached"]
@@ -683,6 +718,7 @@ class BeaconApp:
             "breakers": breakers,
             "routing": routing,
             "queues": queues,
+            "ingest": ingest,
             "stages": stages,
             "events": {
                 "lastSeq": journal.last_seq(),

@@ -165,6 +165,11 @@ class EngineConfig:
     # 16 GB v5e.
     device_planes: bool = True
     plane_hbm_budget_gb: float = 11.0
+    # region/dataset-scoped response-cache invalidation (ingest-while-
+    # serving): a publish evicts only cached entries whose dataset set
+    # AND coordinate bracket overlap the new rows, instead of dropping
+    # the whole cache. Off restores the wholesale clear-on-publish.
+    scoped_invalidation: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,6 +196,26 @@ class IngestConfig:
     scan_worker_urls: tuple[str, ...] = ()
     scan_timeout_s: float = 120.0  # per-slice worker call budget
     scan_retries: int = 1  # extra workers tried before local fallback
+    # ingest-while-serving (delta shards + background compaction):
+    # stream_deltas publishes each completed slice of a FIRST-TIME
+    # summarisation to the engine immediately as a queryable delta
+    # shard (read-your-writes before the merge barrier); the base
+    # publish is deferred to the compactor so the fused/mesh stacks and
+    # the response cache are not demolished per submit. delta_max_shards
+    # is the per-(dataset, vcf) delta-tail depth that kicks an early
+    # compaction; compact_interval_s is the background compactor's
+    # cadence (<=0 disables the thread — folds then only run on the
+    # depth trigger or an explicit run_once()).
+    stream_deltas: bool = True
+    delta_max_shards: int = 8
+    compact_interval_s: float = 30.0
+    # defer the end-of-summarisation BASE publish to the compactor
+    # cadence as well (continuous-ingest mode): submits then never pay
+    # a fingerprint bump / stack rebuild inline — the standing deltas
+    # serve until the next fold. Off (default) keeps the base publish
+    # at the end of each summarisation (identical post-submit state to
+    # the pre-delta write path; slices still stream mid-scan).
+    defer_base_publish: bool = False
 
 
 # canonical external-service endpoints (reference indexer:40-42); the
@@ -495,6 +520,10 @@ class BeaconConfig:
             eng_over["response_cache_ttl_s"] = float(
                 env["BEACON_RESPONSE_CACHE_TTL_S"]
             )
+        if "BEACON_SCOPED_INVALIDATION" in env:
+            eng_over["scoped_invalidation"] = (
+                env["BEACON_SCOPED_INVALIDATION"].lower() not in _off
+            )
         if "BEACON_FETCH_PIPELINE_DEPTH" in env:
             eng_over["fetch_pipeline_depth"] = int(
                 env["BEACON_FETCH_PIPELINE_DEPTH"]
@@ -519,6 +548,24 @@ class BeaconConfig:
                 u.strip()
                 for u in env["BEACON_SCAN_WORKERS"].split(",")
                 if u.strip()
+            )
+        if "BEACON_INGEST_WORKERS" in env:
+            ingest_over["workers"] = int(env["BEACON_INGEST_WORKERS"])
+        if "BEACON_STREAM_DELTAS" in env:
+            ingest_over["stream_deltas"] = (
+                env["BEACON_STREAM_DELTAS"].lower() not in _off
+            )
+        if "BEACON_DELTA_MAX_SHARDS" in env:
+            ingest_over["delta_max_shards"] = int(
+                env["BEACON_DELTA_MAX_SHARDS"]
+            )
+        if "BEACON_COMPACT_INTERVAL_S" in env:
+            ingest_over["compact_interval_s"] = float(
+                env["BEACON_COMPACT_INTERVAL_S"]
+            )
+        if "BEACON_DEFER_BASE_PUBLISH" in env:
+            ingest_over["defer_base_publish"] = (
+                env["BEACON_DEFER_BASE_PUBLISH"].lower() not in _off
             )
         ingest = IngestConfig(**ingest_over)
         auth = AuthConfig(

@@ -38,7 +38,11 @@ from .index.columnar import FLAG, VariantIndexShard
 from .ops import make_device_index, run_queries_auto
 from .ops.kernel import QuerySpec, encode_queries
 from .payloads import VariantQueryPayload, VariantSearchResponse
-from .response_cache import ResponseCache, response_cache_key
+from .response_cache import (
+    ResponseCache,
+    response_cache_key,
+    response_cache_scope,
+)
 from .telemetry import annotate, percentiles, publish_event
 from .utils.chrom import chromosome_code
 from .utils.trace import span
@@ -179,6 +183,32 @@ def host_match_rows(
             upper=True,
         )
     return idx[ok]
+
+
+def shard_regions(shard: VariantIndexShard) -> list[tuple[str, int, int]]:
+    """Per-chromosome coordinate envelope ``[(chrom, lo, hi), ...]`` of
+    a shard's rows — the scope a delta publish invalidates the response
+    cache with. ``hi`` covers both start positions and record ends, so
+    any query bracket that could match a row overlaps its envelope."""
+    from .utils.chrom import CODE_TO_CHROMOSOME
+
+    out: list[tuple[str, int, int]] = []
+    off = shard.chrom_offsets
+    pos = shard.cols["pos"]
+    rec_end = shard.cols["rec_end"]
+    for code in range(len(off) - 1):
+        lo, hi = int(off[code]), int(off[code + 1])
+        if lo == hi:
+            continue
+        chrom = CODE_TO_CHROMOSOME.get(code, "")
+        out.append(
+            (
+                chrom,
+                int(pos[lo:hi].min()),
+                int(max(pos[lo:hi].max(), rec_end[lo:hi].max())),
+            )
+        )
+    return out
 
 
 def _popcount_masked(plane_row: np.ndarray, mask: np.ndarray) -> int:
@@ -598,6 +628,31 @@ def materialize_response(
     )
 
 
+def register_delta_metrics(registry, supplier) -> None:
+    """The ingest-while-serving delta-tail series. ``supplier`` returns
+    :meth:`VariantEngine.delta_metrics` (or ``{}`` on engines without a
+    delta registry) — the series exist as zeros on every deployment
+    shape so the catalogue stays stable."""
+
+    def field(name):
+        def collect():
+            stats = supplier() or {}
+            return stats.get(name, 0)
+
+        return collect
+
+    registry.counter(
+        "ingest.delta_publishes",
+        "delta shards published for immediate serving",
+        fn=field("publishes"),
+    )
+    registry.gauge(
+        "ingest.delta_shards",
+        "delta shards currently standing (awaiting compaction)",
+        fn=field("shards"),
+    )
+
+
 class VariantEngine:
     """Holds device-resident indexes and answers variant queries.
 
@@ -684,6 +739,23 @@ class VariantEngine:
         # fingerprints) reads it per request, so it must be O(1) and
         # never iterate _indexes concurrently with an ingest
         self._fingerprint = ""
+        # ingest-while-serving delta tail: base_key -> {epoch: shard}.
+        # A delta is just another (dataset, vcf)-keyed shard — small,
+        # host-served (no device index), tagged with its coordinate
+        # envelope and a per-key epoch. Deltas publish WITHOUT touching
+        # the mesh/fused dirty flags or the base fingerprint, so the
+        # warm base stacks keep serving across a publish; a base
+        # publish (compaction / re-ingest) atomically drops the folded
+        # epochs. All three fingerprint views and the serving list are
+        # rebuilt copy-on-write under _mesh_lock so the query hot path
+        # never iterates a dict an ingest is mutating.
+        self._deltas: dict[tuple[str, str], dict[int, object]] = {}
+        self._delta_seq: dict[tuple[str, str], int] = {}
+        self._base_fingerprint = ""
+        self._ds_fingerprints: dict[str, str] = {}
+        self._ds_full_fingerprints: dict[str, str] = {}
+        self._serve_list: list = []
+        self.delta_publishes = 0
 
     # -- index management ---------------------------------------------------
 
@@ -717,6 +789,7 @@ class VariantEngine:
             prior = self._indexes.get(key)
             if prior is not None and prior[2] is not None:
                 self._indexes[key] = (prior[0], prior[1], None)
+                self._rebuild_serving_state_locked()
             prior = None  # noqa: F841
             # resident planes (the same key's were just republished
             # plane-less above, so every remaining p counts) + EVERY
@@ -801,24 +874,207 @@ class VariantEngine:
         reservation release in ONE critical section: a concurrent search
         must never pair the new shard with a stale mesh stack, and the
         reservation must convert to residency atomically (never counted
-        twice, never counted nowhere)."""
+        twice, never counted nowhere).
+
+        This is the BASE publish seam (initial ingest, re-ingest, and
+        the compactor's fold): it bumps the base fingerprint, dirties
+        the fused/mesh stacks, and atomically drops the delta epochs
+        the published shard folded (``meta['delta_epoch']`` = highest
+        folded epoch; absent means wholesale replacement — every delta
+        for the key dies with it). Cache invalidation is scoped to the
+        published dataset — entries touching only other datasets keep
+        serving (their keys embed per-dataset components that did not
+        change)."""
         with self._mesh_lock:
             self._mesh_dirty = True
             self._fused_dirty = True
             self._fused_gen += 1
             self._indexes[key] = (shard, dindex, planes)
-            self._fingerprint = "&".join(
-                f"{ds}|{vcf}|{s.meta.get('variant_count')}"
-                f"|{s.meta.get('call_count')}|{s.n_rows}"
-                for (ds, vcf), (s, *_r) in sorted(self._indexes.items())
-            )
+            # epoch monotonicity survives restarts: a reloaded base
+            # carries the highest epoch it folded, and new deltas must
+            # number PAST it or a stale on-disk artifact could
+            # masquerade as covering them
+            baked = shard.meta.get("delta_epoch") or 0
+            if baked > self._delta_seq.get(key, 0):
+                self._delta_seq[key] = baked
+            tail = self._deltas.get(key)
+            if tail:
+                folded = shard.meta.get("delta_epoch")
+                kept = (
+                    {}
+                    if folded is None
+                    else {e: s for e, s in tail.items() if e > folded}
+                )
+                deltas = dict(self._deltas)
+                if kept:
+                    deltas[key] = kept
+                else:
+                    deltas.pop(key, None)
+                self._deltas = deltas
+            self._rebuild_serving_state_locked()
             self._plane_reserved.pop(
                 getattr(planes, "_hbm_reservation", None), None
             )
-        # the fingerprint in every cache key already makes old entries
-        # unreachable; clearing frees their memory immediately
-        if self._response_cache is not None:
-            self._response_cache.invalidate()
+        # the per-dataset fingerprint component in every cache key
+        # already makes this dataset's old entries unreachable; the
+        # scoped invalidation frees them now WITHOUT dropping other
+        # datasets' warm entries (wholesale clear when the knob is off)
+        self._invalidate_cache(key[0], None)
+
+    def _invalidate_cache(self, dataset_id: str, regions) -> None:
+        """Evict cache entries a publish could have answered differently:
+        scoped to (dataset, per-chromosome coordinate envelope) when
+        scoped invalidation is on, wholesale otherwise. ``regions`` is
+        ``[(chrom, lo, hi), ...]`` or None for every region."""
+        cache = self._response_cache
+        if cache is None:
+            return
+        if not getattr(self.config.engine, "scoped_invalidation", True):
+            cache.invalidate()
+            return
+        if regions is None:
+            cache.invalidate_scope([dataset_id], None, None)
+            return
+        for chrom, lo, hi in regions:
+            cache.invalidate_scope([dataset_id], chrom, (lo, hi))
+
+    def _rebuild_serving_state_locked(self) -> None:
+        """Recompute the serving list + all three fingerprint views
+        (held under ``_mesh_lock``): the base fingerprint (base shards
+        only — the staleness signal the fused/mesh stacks and the pod
+        dispatch tier key on, STABLE across delta publishes), the
+        per-dataset components (response-cache keys), and the full
+        fingerprint (base + delta tail — the freshness signal async-job
+        keys and worker ``/datasets`` replica grouping need). All are
+        rebound as fresh objects so lock-free readers never observe a
+        half-mutated structure."""
+        serve: list = []
+        base_parts: list[str] = []
+        ds_fp: dict[str, str] = {}
+        for (ds, vcf), (s, d, p) in sorted(self._indexes.items()):
+            comp = (
+                f"{vcf}|{s.meta.get('variant_count')}"
+                f"|{s.meta.get('call_count')}|{s.n_rows}"
+            )
+            base_parts.append(f"{ds}|{comp}")
+            ds_fp[ds] = f"{ds_fp[ds]}&{comp}" if ds in ds_fp else comp
+            serve.append((ds, vcf, (s, d, p)))
+        delta_parts: list[str] = []
+        for (ds, vcf), tail in sorted(self._deltas.items()):
+            for epoch, s in sorted(tail.items()):
+                serve.append((ds, f"{vcf}#d{epoch}", (s, None, None)))
+                delta_parts.append(f"{ds}|{vcf}#d{epoch}|{s.n_rows}")
+        serve.sort(key=lambda t: (t[0], t[1]))
+        ds_full: dict[str, str] = {}
+        for (ds, vcf), (s, _d, _p) in sorted(self._indexes.items()):
+            part = (
+                f"{vcf}|{s.meta.get('variant_count')}"
+                f"|{s.meta.get('call_count')}|{s.n_rows}"
+            )
+            ds_full[ds] = f"{ds_full[ds]}&{part}" if ds in ds_full else part
+        for (ds, vcf), tail in sorted(self._deltas.items()):
+            for epoch, s in sorted(tail.items()):
+                part = f"{vcf}#d{epoch}|{s.n_rows}"
+                ds_full[ds] = (
+                    f"{ds_full[ds]}&{part}" if ds in ds_full else part
+                )
+        self._serve_list = serve
+        self._base_fingerprint = "&".join(base_parts)
+        self._fingerprint = self._base_fingerprint + (
+            "&" + "&".join(delta_parts) if delta_parts else ""
+        )
+        self._ds_fingerprints = ds_fp
+        self._ds_full_fingerprints = ds_full
+
+    def add_delta(self, shard: VariantIndexShard) -> int:
+        """Publish a small delta shard IMMEDIATELY (read-your-writes):
+        the rows become queryable on the next search without touching
+        the warm base stacks — the mesh/fused state stays clean, the
+        base fingerprint is unchanged, and only cache entries whose
+        dataset AND region overlap the new rows are evicted. Returns
+        the assigned epoch. The caller asserts the rows are NEW (not
+        already present in the key's base shard); the background
+        compactor later folds the tail into the base via
+        :meth:`add_index` with ``meta['delta_epoch']`` set."""
+        key = (
+            shard.meta.get("dataset_id", ""),
+            shard.meta.get("vcf_location", ""),
+        )
+        regions = shard_regions(shard)
+        with self._mesh_lock:
+            epoch = self._delta_seq.get(key, 0) + 1
+            self._delta_seq[key] = epoch
+            shard.meta["delta_epoch"] = epoch
+            tail = dict(self._deltas.get(key, {}))
+            tail[epoch] = shard
+            deltas = dict(self._deltas)
+            deltas[key] = tail
+            self._deltas = deltas
+            self._rebuild_serving_state_locked()
+            self.delta_publishes += 1
+        self._invalidate_cache(key[0], regions)
+        publish_event(
+            "ingest.delta_publish",
+            dataset=key[0],
+            vcf=key[1],
+            epoch=epoch,
+            rows=shard.n_rows,
+        )
+        return epoch
+
+    def has_index(self, dataset_id: str, vcf_location: str) -> bool:
+        """Whether a BASE shard is published for the key (the streaming
+        ingest gate: re-summarising an already-served VCF must not
+        stream its slices as deltas — they would duplicate base rows)."""
+        return (dataset_id, vcf_location) in self._indexes
+
+    def delta_depth(self, dataset_id: str, vcf_location: str) -> int:
+        """Delta shards standing for the key (the compaction trigger)."""
+        return len(self._deltas.get((dataset_id, vcf_location), ()))
+
+    def delta_snapshot(self):
+        """``[(key, base_shard|None, [(epoch, shard), ...]), ...]`` for
+        every key with a standing delta tail, under the publish lock —
+        the compactor folds from this."""
+        with self._mesh_lock:
+            out = []
+            for key, tail in sorted(self._deltas.items()):
+                base = self._indexes.get(key)
+                out.append(
+                    (key, base[0] if base else None, sorted(tail.items()))
+                )
+            return out
+
+    def delta_stats(self) -> dict:
+        """Per-dataset delta-tail depth for ``/debug/status``:
+        ``{dataset: {"shards": n, "rows": m}}``. Lock-free over the
+        copy-on-write ``_deltas`` snapshot — diagnostic surfaces must
+        answer while a stack rebuild holds the publish lock."""
+        deltas = self._deltas
+        out: dict = {}
+        for (ds, _vcf), tail in deltas.items():
+            agg = out.setdefault(ds, {"shards": 0, "rows": 0})
+            agg["shards"] += len(tail)
+            agg["rows"] += sum(s.n_rows for s in tail.values())
+        return out
+
+    def delta_tail(self, dataset_id: str, vcf_location: str) -> dict:
+        """One key's standing tail: ``{"shards": n, "rows": m}``
+        (lock-free snapshot — the inline-fold ledger record reads it)."""
+        tail = self._deltas.get((dataset_id, vcf_location), {})
+        return {
+            "shards": len(tail),
+            "rows": sum(s.n_rows for s in tail.values()),
+        }
+
+    def delta_metrics(self) -> dict:
+        """The ``ingest.*`` series values (register_delta_metrics);
+        lock-free — /metrics scrapes must not queue behind a rebuild."""
+        deltas = self._deltas
+        return {
+            "publishes": self.delta_publishes,
+            "shards": sum(len(t) for t in deltas.values()),
+        }
 
     _AUTO_PLANES = object()  # sentinel: build planes unless caller chose
 
@@ -835,6 +1091,26 @@ class VariantEngine:
         if planes is VariantEngine._AUTO_PLANES:
             planes = self._build_planes(key, shard, dindex)
         self._publish_index(key, shard, dindex, planes)
+
+    def rebuild_stacks(self) -> None:
+        """Rebuild the fused + mesh serving stacks INLINE. The
+        background compactor calls this right after a fold so the
+        first post-compaction query finds warm state instead of paying
+        the build (or serving per-shard while a background build
+        runs). Best-effort: a failed build leaves the per-shard paths
+        serving exactly as the lazy rebuild would."""
+        try:
+            self._fused_ready(wait=True)
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "post-compaction fused rebuild failed"
+            )
+        try:
+            self._mesh_ready()
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "post-compaction mesh rebuild failed"
+            )
 
     def warmup(self) -> int:
         """Pre-compile every kernel program serving can dispatch against
@@ -953,7 +1229,9 @@ class VariantEngine:
             self._batcher.close()
 
     def datasets(self) -> list[str]:
-        return sorted({ds for ds, _ in self._indexes})
+        # the prebuilt serving list (base + delta tail) so a dataset
+        # whose FIRST rows arrived as deltas is already routable
+        return sorted({ds for ds, _vcf, _t in self._serve_list})
 
     @property
     def batcher(self):
@@ -971,33 +1249,57 @@ class VariantEngine:
             return [(k, v[0]) for k, v in sorted(self._indexes.items())]
 
     def index_fingerprint(self) -> str:
-        """Identity of the loaded index set; folds into the response
-        cache and async-query cache keys so cached results are
-        invalidated by any (re-)ingestion. O(1): the string is
-        maintained under the publish lock (_publish_index), never
-        recomputed on the query hot path."""
+        """FULL identity of the served data set — base shards AND the
+        standing delta tail. Folds into async-query job keys and the
+        worker ``/datasets`` identity, so any publish (base or delta)
+        makes dependent caches re-execute. O(1): maintained under the
+        publish lock, never recomputed on the query hot path."""
         return self._fingerprint
+
+    def base_fingerprint(self) -> str:
+        """Identity of the BASE shards only — stable across delta
+        publishes, bumped by compaction/re-ingest. This is the
+        staleness signal the warm dispatch stacks (engine fused/mesh
+        state, ``parallel.dispatch.MeshDispatchTier``) key on: between
+        compactions they keep serving base rows and only the delta
+        tail pays per-shard dispatch."""
+        return self._base_fingerprint
+
+    def cache_fingerprint(self, dataset_ids) -> str:
+        """The response-cache key's fingerprint component for a query
+        over ``dataset_ids`` (empty = all loaded datasets): per-dataset
+        BASE components only. Delta publishes deliberately leave it
+        unchanged — their freshness is enforced by scoped invalidation
+        — so a publish no longer rotates every key and resets the warm
+        hit rate."""
+        if not dataset_ids:
+            return self._base_fingerprint
+        ds_fp = self._ds_fingerprints
+        return "&".join(
+            f"{ds}={ds_fp.get(ds, '')}" for ds in sorted(set(dataset_ids))
+        )
 
     def dataset_fingerprints(self) -> dict[str, str]:
         """Per-dataset identity — the same ``vcf|variant_count|
         call_count|n_rows`` components :meth:`index_fingerprint` folds,
-        grouped by dataset. The worker ``/datasets`` endpoint serves
-        this so a coordinator groups only IDENTICAL shard copies as
-        replicas and routes around a worker serving a stale copy
-        (dispatch._group_replicas)."""
-        out: dict[str, str] = {}
-        for (ds, vcf), (s, *_r) in sorted(self._indexes.items()):
-            part = (
-                f"{vcf}|{s.meta.get('variant_count')}"
-                f"|{s.meta.get('call_count')}|{s.n_rows}"
-            )
-            out[ds] = f"{out[ds]}&{part}" if ds in out else part
-        return out
+        grouped by dataset, PLUS the delta-tail components. The worker
+        ``/datasets`` endpoint serves this so a coordinator groups only
+        IDENTICAL shard copies as replicas and routes around a worker
+        serving a stale copy (dispatch._group_replicas) — a replica
+        whose delta tail differs is not interchangeable. LOCK-FREE
+        (copy-on-write snapshot): ``_mesh_ready`` holds the publish
+        lock for the whole multi-second stack build, and a replica
+        probe stalling behind it would read as a dead worker."""
+        return dict(self._ds_full_fingerprints)
 
     def indexes_for(self, dataset_ids: list[str]):
-        for (ds, vcf), pair in sorted(self._indexes.items()):
+        """Every serving (base + delta) triple for the datasets, in
+        sorted key order. Delta entries carry a ``vcf#d<epoch>`` label
+        so base and tail rows of one VCF stay distinct response keys
+        (and never share a fused pre-match)."""
+        for ds, vcf, triple in self._serve_list:
             if not dataset_ids or ds in dataset_ids:
-                yield ds, vcf, pair
+                yield ds, vcf, triple
 
     # -- query path ---------------------------------------------------------
 
@@ -1008,22 +1310,33 @@ class VariantEngine:
 
         Fronted by the fingerprint-keyed response cache: a repeated
         query (incl. a repeated MISS — negative entries) answers from
-        host memory with zero device launches; any (re-)ingestion bumps
-        ``index_fingerprint()`` so the repeat re-executes against the
-        new index set."""
+        host memory with zero device launches. Keys embed per-dataset
+        BASE fingerprint components (``cache_fingerprint``) — a base
+        publish rotates only the touched dataset's keys; a delta
+        publish rotates none and instead scope-evicts the overlapping
+        entries, so non-overlapping warm entries keep hitting across
+        continuous ingest. The generation captured before dispatch
+        stops a publish that lands mid-search from being outrun by a
+        stale store."""
         cache = self._response_cache
         key = None
+        scope = None
+        gen = None
         if cache is not None:
-            key = response_cache_key(self.index_fingerprint(), payload)
+            key = response_cache_key(
+                self.cache_fingerprint(payload.dataset_ids), payload
+            )
             hit = cache.get(key)
             if hit is not None:
                 annotate(response_cache="hit")
                 return hit
+            scope = response_cache_scope(payload)
+            gen = cache.generation()
         annotate(response_cache="miss" if cache is not None else "off")
         with span("engine.search") as sp:
             responses = self._search(payload, sp)
         if key is not None:
-            cache.put(key, responses)
+            cache.put(key, responses, scope=scope, gen=gen)
         return responses
 
     def cache_stats(self) -> dict | None:
@@ -1060,6 +1373,7 @@ class VariantEngine:
         if self._batcher is not None:
             self._batcher.register_metrics(registry)
         register_cache_metrics(registry, lambda: self._response_cache)
+        register_delta_metrics(registry, self.delta_metrics)
 
     def _materialize_timing(self) -> dict:
         """Host-materialisation quantiles alone — the gauge callback
@@ -1385,17 +1699,41 @@ class VariantEngine:
         if not targets:
             return []
 
+        # mesh serving covers the BASE shard snapshot it was built from;
+        # the delta tail (and any racing republish) is excluded and
+        # rides the per-shard scatter below — the base stack stays warm
+        # across delta publishes instead of going cold per ingest
+        mesh_responses: dict | None = None
         if len(targets) > 1:
             state = self._mesh_ready()
             if state is not None:
-                try:
-                    return self._mesh_search(
-                        state, targets, spec_base, payload, sp
-                    )
-                except Exception:
-                    logging.getLogger(__name__).exception(
-                        "mesh search failed; falling back to thread scatter"
-                    )
+                shard_of = state[4]
+                covered = [
+                    t
+                    for t in targets
+                    if shard_of.get((t[0], t[1])) is t[2]
+                ]
+                if covered:
+                    try:
+                        got = self._mesh_search(
+                            state, covered, spec_base, payload, sp
+                        )
+                        mesh_responses = {
+                            (t[0], t[1]): r
+                            for t, r in zip(covered, got)
+                        }
+                    except Exception:
+                        logging.getLogger(__name__).exception(
+                            "mesh search failed; falling back to "
+                            "thread scatter"
+                        )
+                        mesh_responses = None
+        if mesh_responses is not None:
+            targets = [
+                t for t in targets if (t[0], t[1]) not in mesh_responses
+            ]
+            if not targets:
+                return list(mesh_responses.values())
 
         # cross-shard fused dispatch: ONE stacked-index launch answers
         # this query for every covered target (instead of one launch
@@ -1493,6 +1831,14 @@ class VariantEngine:
             # per-dataset dispatch, search_variants.py:77-118): overlaps
             # the per-shard device round-trips instead of serialising them
             responses = list(self._scatter.map(_one_target, targets))
+        if mesh_responses is not None:
+            # reassemble mesh-served base responses + scatter-served
+            # tail in the original sorted target order
+            by_key = dict(mesh_responses)
+            by_key.update(
+                {(t[0], t[1]): r for t, r in zip(targets, responses)}
+            )
+            responses = [by_key[k] for k in sorted(by_key)]
         sp.note(targets=len(targets), responses=len(responses))
         return responses
 

@@ -33,6 +33,14 @@ Instrumented sites:
   (``parallel/dispatch.py MeshDispatchTier.search``); an ``error``
   here exercises the fall-back-once-to-scatter contract
   (``mesh.fallbacks`` counter + ``mesh.fallback`` journal event).
+- ``compaction.fold`` — the background delta compactor
+  (``ingest/service.py DeltaCompactor._fold``). Hit TWICE per fold
+  with ``detail`` ``"<dataset>:<vcf>:merge"`` (before the merge/
+  persist) and ``"<dataset>:<vcf>:publish"`` (after the atomic save,
+  before the engine swap), so ``match`` can crash either side of the
+  durability seam. An ``error`` anywhere leaves base + deltas serving
+  duplicate-free and the next run completes the fold — the
+  ``-m resilience`` test asserts exactly that.
 
 Fault kinds: ``error`` raises :class:`FaultError`; ``latency`` sleeps
 ``ms``; ``hang`` sleeps ``ms`` too but defaults much longer — a hang is

@@ -86,6 +86,23 @@ class JobLedger:
                 state TEXT,
                 updated_at REAL
             );
+            CREATE TABLE IF NOT EXISTS delta_log (
+                dataset_id TEXT,
+                vcf_location TEXT,
+                epoch INTEGER,           -- per-key delta epoch
+                rows INTEGER,
+                published_at REAL,
+                folded_at REAL,          -- NULL while the delta stands
+                PRIMARY KEY (dataset_id, vcf_location, epoch)
+            );
+            CREATE TABLE IF NOT EXISTS compactions (
+                dataset_id TEXT,
+                vcf_location TEXT,
+                folded_through INTEGER,  -- highest epoch folded
+                folded_shards INTEGER,
+                folded_rows INTEGER,
+                completed_at REAL
+            );
             """
         )
         self.conn.commit()
@@ -213,6 +230,68 @@ class JobLedger:
     def vcf_is_summarised(self, vcf_location: str) -> bool:
         s = self.vcf_summary(vcf_location)
         return s is not None and not s["pending"] and s["sample_count"] is not None
+
+    # -- delta / compaction bookkeeping (ingest-while-serving) --------------
+
+    def record_delta_publish(
+        self, dataset_id: str, vcf_location: str, epoch: int, rows: int
+    ) -> None:
+        """One delta shard became queryable (engine.add_delta). The log
+        is observability + audit — correctness does not depend on it
+        (a crashed tail is re-derived by re-summarising the VCF)."""
+        with self._txn():
+            self.conn.execute(
+                "INSERT OR REPLACE INTO delta_log VALUES "
+                "(?, ?, ?, ?, ?, NULL)",
+                (dataset_id, vcf_location, epoch, rows, time.time()),
+            )
+
+    def record_compaction(
+        self,
+        dataset_id: str,
+        vcf_location: str,
+        *,
+        folded_through: int,
+        folded_shards: int,
+        folded_rows: int,
+    ) -> None:
+        """One completed fold: stamps the folded deltas and appends a
+        compaction row (the audit trail /debug and the bench read)."""
+        with self._txn():
+            self.conn.execute(
+                "UPDATE delta_log SET folded_at = ? WHERE dataset_id = ? "
+                "AND vcf_location = ? AND epoch <= ? AND folded_at IS NULL",
+                (time.time(), dataset_id, vcf_location, folded_through),
+            )
+            self.conn.execute(
+                "INSERT INTO compactions VALUES (?, ?, ?, ?, ?, ?)",
+                (
+                    dataset_id,
+                    vcf_location,
+                    folded_through,
+                    folded_shards,
+                    folded_rows,
+                    time.time(),
+                ),
+            )
+
+    def delta_summary(self) -> dict:
+        """Aggregate delta/compaction counters: standing (unfolded)
+        deltas, lifetime publishes, and completed compaction runs."""
+        standing, published = self.conn.execute(
+            "SELECT COALESCE(SUM(CASE WHEN folded_at IS NULL THEN 1 "
+            "ELSE 0 END), 0), COUNT(*) FROM delta_log"
+        ).fetchone()
+        runs, rows = self.conn.execute(
+            "SELECT COUNT(*), COALESCE(SUM(folded_rows), 0) "
+            "FROM compactions"
+        ).fetchone()
+        return {
+            "standing_deltas": int(standing or 0),
+            "delta_publishes": int(published or 0),
+            "compaction_runs": int(runs or 0),
+            "compaction_folded_rows": int(rows or 0),
+        }
 
     # -- dataset aggregation state (reference Datasets control item) --------
 

@@ -46,6 +46,52 @@ log = logging.getLogger(__name__)
 from ..io import is_remote
 
 
+class _SliceDiskTracker:
+    """Process-wide accounting of slice-shard temp bytes on disk
+    (``ingest.slice_disk_bytes``). Slices used to coexist on disk until
+    the post-merge bulk delete; now each file is deleted the moment its
+    rows are folded (held in memory / merged), so a many-sample
+    cohort's peak temp-disk is ~one slice — ``peak`` lets the bench
+    assert that."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._current = 0
+        self._peak = 0
+
+    def add(self, n: int) -> None:
+        with self._lock:
+            self._current += int(n)
+            self._peak = max(self._peak, self._current)
+
+    def sub(self, n: int) -> None:
+        with self._lock:
+            self._current = max(0, self._current - int(n))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"current": self._current, "peak": self._peak}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._current = 0
+            self._peak = 0
+
+
+#: process-wide like ``transport._STATS`` — the ingest pipeline may be
+#: driven by several services in one process, the disk is one
+SLICE_DISK = _SliceDiskTracker()
+
+
+def register_ingest_metrics(registry) -> None:
+    """The ingest pipeline's process-wide series."""
+    registry.gauge(
+        "ingest.slice_disk_bytes",
+        "slice-shard temp bytes currently on disk",
+        fn=lambda: SLICE_DISK.stats()["current"],
+    )
+
+
 def read_slice_records(
     vcf_path: str | Path, vstart: int, vend: int
 ) -> list:
@@ -155,6 +201,15 @@ class SummarisationPipeline:
         # same dataset must not race-write the same shard files
         self._vcf_locks: dict[str, threading.Lock] = {}
         self._locks_guard = threading.Lock()
+        # streaming-ingest state: keys whose base publish was DEFERRED
+        # (slices already serve as deltas; the compactor folds later),
+        # and a hook the owning service wires to the compactor so a
+        # deep delta tail kicks an early fold
+        self._deferred: set[tuple[str, str]] = set()
+        self.on_delta = None  # callable(dataset_id, vcf, depth) | None
+        self.defer_base = bool(
+            getattr(self.config.ingest, "defer_base_publish", False)
+        )
         # cross-host slice scatter (the reference's <=1000-lambda
         # summariseSlice fan-out): slice jobs round-robin over the
         # configured scan workers; any worker failure falls back to a
@@ -210,6 +265,31 @@ class SummarisationPipeline:
             with self._vcf_lock(vcf):
                 return self._summarise_vcf_locked(dataset_id, vcf)
 
+    def _streaming(self, dataset_id: str, vcf: str) -> bool:
+        """Whether this summarisation streams slices as delta shards:
+        an engine that can host deltas, the knob on, and NO base shard
+        already published for the key — re-summarising a served VCF
+        must not stream, its slices would duplicate base rows until
+        the fold."""
+        eng = self.engine
+        return (
+            eng is not None
+            and getattr(self.config.ingest, "stream_deltas", False)
+            and getattr(eng, "add_delta", None) is not None
+            and not getattr(eng, "has_index", lambda *_a: True)(
+                dataset_id, str(vcf)
+            )
+        )
+
+    def _unlink_slice(self, spath: Path) -> None:
+        """Delete one slice temp file, keeping the disk gauge honest."""
+        try:
+            n = spath.stat().st_size
+            spath.unlink()
+            SLICE_DISK.sub(n)
+        except OSError:
+            pass
+
     def _summarise_vcf_locked(
         self, dataset_id: str, vcf: str
     ) -> VariantIndexShard:
@@ -235,10 +315,54 @@ class SummarisationPipeline:
         slice_dir = self._slice_dir(dataset_id, vcf)
         slice_dir.mkdir(parents=True, exist_ok=True)
 
+        # streaming publication (ingest-while-serving): each slice
+        # becomes queryable the moment it completes — the merge barrier
+        # below no longer holds ALL visibility until the last slice
+        # lands. The finished shards are kept in memory (they are the
+        # published deltas anyway), which is what lets each slice temp
+        # file be deleted immediately: peak temp-disk is ~one slice,
+        # and a crash in the window degrades to a re-scan, not loss.
+        stream = self._streaming(dataset_id, vcf)
+        mem_lock = threading.Lock()
+        shards_mem: dict[tuple[int, int], VariantIndexShard] = {}
+        published_epochs: list[int] = []
+        publish_failures: list = []
+
+        def publish_delta(sl, shard) -> None:
+            with mem_lock:
+                shards_mem[sl] = shard
+            if not stream:
+                return
+            try:
+                epoch = self.engine.add_delta(shard)
+            except Exception:
+                with mem_lock:
+                    publish_failures.append(sl)
+                log.exception(
+                    "delta publish failed for %s %s; rows stay "
+                    "invisible until the merge publishes", vcf, sl
+                )
+                return
+            with mem_lock:
+                published_epochs.append(epoch)
+            try:
+                self.ledger.record_delta_publish(
+                    dataset_id, str(vcf), epoch, shard.n_rows
+                )
+            except Exception:
+                log.warning("delta-publish ledger record failed",
+                            exc_info=True)
+            hook = self.on_delta
+            if hook is not None:
+                depth = getattr(
+                    self.engine, "delta_depth", lambda *_a: 0
+                )(dataset_id, str(vcf))
+                hook(dataset_id, str(vcf), depth)
+
         def run_slice(sl: tuple[int, int]):
             spath = slice_dir / f"{sl[0]}-{sl[1]}.npz"
             if sl not in pending and spath.exists():
-                return  # finished in a previous run
+                return  # finished in a previous run (merged below)
             if self.scan_pool is not None:
                 from ..index.columnar import save_index_blob
                 from ..payloads import SliceScanPayload
@@ -257,12 +381,19 @@ class SummarisationPipeline:
                         )
                     )
                     meta = save_index_blob(blob, spath)
+                    SLICE_DISK.add(spath.stat().st_size)
                     self.ledger.complete_slice(
                         str(vcf),
                         sl,
                         variant_count=meta["variant_count"],
                         call_count=meta["call_count"],
                     )
+                    if stream:
+                        # the blob landed as a file; lift it into the
+                        # delta registry and drop the temp file now
+                        shard = load_index(spath)
+                        publish_delta(sl, shard)
+                        self._unlink_slice(spath)
                     return
                 except Exception:
                     log.exception(
@@ -280,22 +411,34 @@ class SummarisationPipeline:
             )
             # slice shards are merged and deleted moments later, so the
             # zlib pass is skipped UNLESS the genotype bit planes are
-            # large: planes are mostly zeros (compress 10-50x) and every
-            # slice coexists on disk until the merge, so an uncompressed
-            # many-sample cohort would multiply peak temp-disk usage
+            # large: planes are mostly zeros (compress 10-50x) and the
+            # crash-resume checkpoint briefly coexists with its
+            # siblings, so an uncompressed many-sample cohort would
+            # multiply peak temp-disk usage
             planes = sum(
                 p.nbytes
                 for p in (shard.gt_bits, shard.gt_bits2,
                           shard.tok_bits1, shard.tok_bits2)
                 if p is not None
             )
+            if spath.exists():
+                # remote path failed AFTER persisting its blob (e.g. a
+                # ledger error): retire that file's tracked bytes
+                # before re-saving, or the gauge drifts up permanently
+                self._unlink_slice(spath)
             save_index(shard, spath, compress=planes > 16 * 1024 * 1024)
+            SLICE_DISK.add(spath.stat().st_size)
             self.ledger.complete_slice(
                 str(vcf),
                 sl,
                 variant_count=shard.meta["variant_count"],
                 call_count=shard.meta["call_count"],
             )
+            publish_delta(sl, shard)
+            if stream:
+                # the rows live in the delta registry; a crash before
+                # the merge re-scans this slice (merge fallback below)
+                self._unlink_slice(spath)
 
         workers = max(1, self.config.ingest.workers)
         if len(plan.slices) <= 1 or workers == 1:
@@ -308,7 +451,29 @@ class SummarisationPipeline:
         shards = []
         for sl in plan.slices:
             spath = slice_dir / f"{sl[0]}-{sl[1]}.npz"
-            shards.append(load_index(spath))
+            shard = shards_mem.get(sl)
+            if shard is None and spath.exists():
+                shard = load_index(spath)
+            if shard is None:
+                # completed in a crashed streaming run whose temp file
+                # was already folded away: re-scan — the VCF itself is
+                # the durable source of truth
+                log.info(
+                    "slice %s of %s missing on disk; re-scanning", sl, vcf
+                )
+                shard = scan_slice_to_shard(
+                    vcf,
+                    sl[0],
+                    sl[1],
+                    dataset_id=dataset_id,
+                    sample_names=sample_names,
+                )
+            # fold-then-delete: each slice's temp file dies as soon as
+            # its rows are in the merge working set, not after the full
+            # merge — peak temp-disk during the merge is one slice
+            if spath.exists():
+                self._unlink_slice(spath)
+            shards.append(shard)
         merged = (
             merge_shards(shards)
             if shards
@@ -319,9 +484,17 @@ class SummarisationPipeline:
                 sample_names=sample_names,
             )
         )
-        # merged meta keeps the identity of this (dataset, vcf) pair
+        # merged meta keeps the identity of this (dataset, vcf) pair.
+        # delta_epoch marks how far this artifact folds the delta tail:
+        # publishing it to the engine atomically retires exactly those
+        # epochs (merge_shards copied shards[0].meta, which may carry a
+        # single slice's epoch — it MUST be overwritten here).
         merged.meta["dataset_id"] = dataset_id
         merged.meta["vcf_location"] = str(vcf)
+        if published_epochs:
+            merged.meta["delta_epoch"] = max(published_epochs)
+        else:
+            merged.meta.pop("delta_epoch", None)
         save_index(merged, final)
         if self.config.ingest.export_portable:
             # reference-layout binary region files (vcf-summaries/ role,
@@ -332,11 +505,39 @@ class SummarisationPipeline:
                 merged, self.config.storage.index_dir / "portable" / dataset_id
             )
         for p in slice_dir.glob("*"):
-            p.unlink()
+            self._unlink_slice(p)
         slice_dir.rmdir()
+        if (
+            stream
+            and published_epochs
+            and not publish_failures
+            and self.defer_base
+        ):
+            # continuous-ingest mode: the rows already serve as deltas,
+            # so the base publish (fingerprint bump + stack dirtying +
+            # cache-key rotation) is deferred to the compactor cadence
+            # instead of demolishing the warm query plane per submit.
+            # Deferral requires EVERY slice's delta to have published —
+            # a failed publish means some rows only exist in the merged
+            # base, and deferring it would leave them unqueryable until
+            # a fold that may never be triggered.
+            with self._locks_guard:
+                self._deferred.add((dataset_id, str(vcf)))
         if resumed:
             log.info("resumed summarisation of %s complete", vcf)
         return merged
+
+    def base_deferred(self, dataset_id: str, vcf: str) -> bool:
+        """Whether this key's base publish was deferred to the
+        compactor (its slices already serve as delta shards)."""
+        with self._locks_guard:
+            return (dataset_id, str(vcf)) in self._deferred
+
+    def clear_deferred(self, dataset_id: str, vcf: str) -> None:
+        """The compactor folded this key's tail into a published base —
+        future (re-)summarisations publish inline again."""
+        with self._locks_guard:
+            self._deferred.discard((dataset_id, str(vcf)))
 
     # -- dataset stage ------------------------------------------------------
 
@@ -362,8 +563,38 @@ class SummarisationPipeline:
             shard = self.summarise_vcf(dataset_id, vcf)
             shards.append(shard)
             shard_by_vcf[str(vcf)] = shard
-            if self.engine is not None:
+            if self.engine is not None and not self.base_deferred(
+                dataset_id, str(vcf)
+            ):
+                # publishing a merged shard whose meta carries
+                # delta_epoch IS an inline fold: the engine swaps the
+                # base in and retires the streamed slices' delta
+                # shards in one critical section (duplicate-free)
+                tail = getattr(
+                    self.engine,
+                    "delta_tail",
+                    lambda *_a: {"shards": 0, "rows": 0},
+                )(dataset_id, str(vcf))
                 self.engine.add_index(shard)
+                folded = shard.meta.get("delta_epoch")
+                if tail["shards"] and folded is not None:
+                    try:
+                        # folded_rows counts TAIL rows only — the same
+                        # semantics as DeltaCompactor._fold, so the
+                        # ledger audit and compaction.folded_rows
+                        # metric agree regardless of which path folds
+                        self.ledger.record_compaction(
+                            dataset_id,
+                            str(vcf),
+                            folded_through=int(folded),
+                            folded_shards=tail["shards"],
+                            folded_rows=tail["rows"],
+                        )
+                    except Exception:
+                        log.warning(
+                            "inline-fold ledger record failed",
+                            exc_info=True,
+                        )
 
         distinct = distinct_variant_count(
             shards, max_range_bytes=self.config.ingest.max_range_bytes

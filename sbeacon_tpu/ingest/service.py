@@ -7,22 +7,257 @@ get_vcf_chromosomes) and the SNS summarisation pipeline entry
 (summariseDataset -> summariseVcf -> summariseSlice) — with direct calls
 into the genomics layer. The scheduled path currently summarises
 synchronously; the resumable job-ledger pipeline builds on this surface.
+
+The service also owns the :class:`DeltaCompactor` — the background
+half of ingest-while-serving. The pipeline publishes slices as
+immediately-queryable delta shards; the compactor folds a key's tail
+into its base shard OFF the request path (interval cadence + a
+depth trigger), which is the only place the base fingerprint bumps,
+the fused/mesh stacks rebuild, and the dataset's cache keys rotate —
+once per fold instead of once per submit. The reference's equivalent
+is the SNS-driven async summarisation chain with its minutes-long
+freshness lag; here freshness is one delta publish (sub-second) and
+the heavy work is amortised.
 """
 
 from __future__ import annotations
 
+import logging
+import threading
 from pathlib import Path
 
 from ..config import BeaconConfig
 from ..genomics.tabix import ensure_index, list_chromosomes
-from ..index.columnar import load_index
+from ..harness.faults import fault_point
+from ..index.columnar import load_index, merge_shards, save_index
+from ..telemetry import publish_event
 from ..utils.chrom import get_matching_chromosome  # noqa: F401 (API parity)
 from .ledger import JobLedger
 from .pipeline import SummarisationPipeline
 
+log = logging.getLogger(__name__)
+
 
 class VcfLocationError(ValueError):
     """A submitted VCF is missing or unindexed (400 at the API boundary)."""
+
+
+class DeltaCompactor:
+    """Folds standing delta tails into base shards, off the request path.
+
+    One fold per (dataset, vcf) key: merge base + tail (or adopt the
+    summarisation's already-merged on-disk artifact when it covers the
+    tail), persist atomically, then publish through
+    ``engine.add_index`` — which swaps base-in/deltas-out in ONE
+    critical section, so queries never see the rows doubled or
+    missing. A crash anywhere before the publish leaves base + deltas
+    serving exactly as before and the next run re-folds (the
+    ``compaction.fold`` fault site injects exactly that). After the
+    publish the fused/mesh stacks rebuild inline here, so the first
+    post-fold query finds them warm.
+    """
+
+    def __init__(self, engine, pipeline, ledger, config: BeaconConfig):
+        self.engine = engine
+        self.pipeline = pipeline
+        self.ledger = ledger
+        self.config = config
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._fold_lock = threading.Lock()
+        self._state_lock = threading.Lock()
+        self._runs = 0
+        self._folded_rows = 0
+        self._folded_shards = 0
+        self._failures = 0
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Arm the background thread (interval cadence + wake events);
+        idempotent."""
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name="delta-compactor", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+
+    def notify(self, dataset_id: str, vcf: str, depth: int) -> None:
+        """A delta published (pipeline hook): a tail at or past
+        ``delta_max_shards`` kicks an early fold instead of waiting
+        out the interval. With the background thread disabled
+        (``compact_interval_s <= 0``) the fold runs inline on the
+        publishing thread — the tail depth stays bounded either way."""
+        if depth < max(1, self.config.ingest.delta_max_shards):
+            return
+        if self._thread is not None and self._thread.is_alive():
+            self._wake.set()
+            return
+        try:
+            self.run_once()
+        except Exception:
+            log.exception("inline depth-triggered compaction failed")
+
+    def _loop(self) -> None:
+        interval = self.config.ingest.compact_interval_s
+        while not self._stop.is_set():
+            self._wake.wait(timeout=interval if interval > 0 else None)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            try:
+                self.run_once()
+            except Exception:
+                log.exception("background compaction pass failed")
+
+    # -- folding -------------------------------------------------------------
+
+    def run_once(self) -> dict:
+        """Fold every key with a standing delta tail; returns
+        ``{key: folded_rows}`` for the keys folded. Failures are
+        per-key isolated — one crashed fold (fault injection, disk
+        error) leaves that key's base + deltas serving and the other
+        keys still fold."""
+        out: dict = {}
+        with self._fold_lock:
+            for key, base, tail in self.engine.delta_snapshot():
+                try:
+                    out[key] = self._fold(key, base, tail)
+                except Exception:
+                    with self._state_lock:
+                        self._failures += 1
+                    log.exception(
+                        "compaction failed for %s; base + deltas keep "
+                        "serving, next run retries", key
+                    )
+        return out
+
+    def _fold(self, key, base_shard, tail) -> int:
+        ds, vcf = key
+        epochs = [e for e, _s in tail]
+        folded_through = max(epochs)
+        folded_rows = sum(s.n_rows for _e, s in tail)
+        publish_event(
+            "compaction.start",
+            dataset=ds,
+            vcf=vcf,
+            shards=len(tail),
+            rows=folded_rows,
+        )
+        fault_point("compaction.fold", f"{ds}:{vcf}:merge")
+        final = self.pipeline.shard_path(ds, vcf)
+        merged = None
+        if final.exists():
+            # the streamed summarisation already merged + persisted the
+            # full artifact (base publish deferred to us): adopt it when
+            # it provably covers the tail instead of re-merging
+            try:
+                cand = load_index(final)
+                if (cand.meta.get("delta_epoch") or -1) >= folded_through:
+                    merged = cand
+            except Exception:
+                log.warning(
+                    "unreadable base artifact %s; re-merging", final,
+                    exc_info=True,
+                )
+        if merged is None:
+            parts = ([base_shard] if base_shard is not None else []) + [
+                s for _e, s in tail
+            ]
+            merged = merge_shards(parts) if len(parts) > 1 else parts[0]
+            merged.meta["dataset_id"] = ds
+            merged.meta["vcf_location"] = vcf
+            merged.meta["delta_epoch"] = folded_through
+            save_index(merged, final)
+        # the seam: everything above is reversible (pure merge + atomic
+        # tmp-rename save); the publish below swaps base-in/deltas-out
+        # in one engine critical section
+        fault_point("compaction.fold", f"{ds}:{vcf}:publish")
+        self.engine.add_index(merged)
+        self.pipeline.clear_deferred(ds, vcf)
+        # first post-fold query must find the dispatch stacks warm —
+        # rebuilding here IS the "off the request path" contract
+        rebuild = getattr(self.engine, "rebuild_stacks", None)
+        if rebuild is not None:
+            rebuild()
+        try:
+            self.ledger.record_compaction(
+                ds,
+                vcf,
+                folded_through=folded_through,
+                folded_shards=len(tail),
+                folded_rows=folded_rows,
+            )
+        except Exception:
+            log.warning("compaction ledger record failed", exc_info=True)
+        with self._state_lock:
+            self._runs += 1
+            self._folded_rows += folded_rows
+            self._folded_shards += len(tail)
+        publish_event(
+            "compaction.complete",
+            dataset=ds,
+            vcf=vcf,
+            shards=len(tail),
+            rows=folded_rows,
+            foldedThrough=folded_through,
+        )
+        return folded_rows
+
+    # -- observability -------------------------------------------------------
+
+    def metrics(self) -> dict:
+        with self._state_lock:
+            return {
+                "runs": self._runs,
+                "folded_rows": self._folded_rows,
+                "folded_shards": self._folded_shards,
+                "failures": self._failures,
+            }
+
+    def stats(self) -> dict:
+        """The ``/debug/status`` rollup: counters + live per-dataset
+        delta-tail depth."""
+        out = self.metrics()
+        out["running"] = (
+            self._thread is not None and self._thread.is_alive()
+        )
+        out["deltaTails"] = self.engine.delta_stats()
+        return out
+
+
+def register_compaction_metrics(registry, supplier) -> None:
+    """``compaction.*`` series; ``supplier`` returns
+    :meth:`DeltaCompactor.metrics` or ``{}`` (no compactor wired) so
+    the catalogue stays deployment-stable."""
+
+    def field(name):
+        def collect():
+            stats = supplier() or {}
+            return stats.get(name, 0)
+
+        return collect
+
+    registry.counter(
+        "compaction.runs",
+        "completed delta-tail folds",
+        fn=field("runs"),
+    )
+    registry.counter(
+        "compaction.folded_rows",
+        "delta rows folded into base shards",
+        fn=field("folded_rows"),
+    )
 
 
 class IngestService:
@@ -45,6 +280,26 @@ class IngestService:
         self.pipeline = SummarisationPipeline(
             self.config, ledger=self.ledger, engine=engine, store=store
         )
+        # ingest-while-serving: the compactor folds delta tails off the
+        # request path; armed only for engines that host a delta
+        # registry (a DistributedEngine coordinator passes its LOCAL
+        # engine here — shard ownership lives on hosts)
+        self.compactor: DeltaCompactor | None = None
+        if engine is not None and getattr(engine, "add_delta", None):
+            self.compactor = DeltaCompactor(
+                engine, self.pipeline, self.ledger, self.config
+            )
+            self.pipeline.on_delta = self.compactor.notify
+            if self.config.ingest.compact_interval_s > 0:
+                self.compactor.start()
+
+    def compaction_metrics(self) -> dict:
+        return {} if self.compactor is None else self.compactor.metrics()
+
+    def close(self) -> None:
+        """Stop the background compactor (app teardown)."""
+        if self.compactor is not None:
+            self.compactor.close()
 
     # -- submission-time checks --------------------------------------------
 

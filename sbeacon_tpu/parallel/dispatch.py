@@ -1022,6 +1022,18 @@ class MeshDispatchTier:
         pairs = snap()
         return [k for k, _s in pairs], [s for _k, s in pairs]
 
+    def _base_fp(self) -> str:
+        """The BASE-shard fingerprint: stable across delta publishes
+        (only compaction/re-ingest bumps it), so a delta publish does
+        NOT cold-start this tier — the stack keeps serving base rows
+        and the delta tail is served per-shard in :meth:`search`.
+        Engines without a delta registry fall back to the full
+        fingerprint (identical staleness behaviour to before)."""
+        base = getattr(self.engine, "base_fingerprint", None)
+        if base is not None:
+            return base()
+        return self.engine.index_fingerprint()
+
     def _ready(self, wait: bool = False):
         """The current state, or None while unbuilt/stale (the caller
         then keeps the scatter paths — freshness beats the mesh win).
@@ -1029,7 +1041,7 @@ class MeshDispatchTier:
         builds inline on the caller's clock."""
         if not self.available():
             return None
-        fp = self.engine.index_fingerprint()
+        fp = self._base_fp()
         while True:
             with self._lock:
                 state = self._state
@@ -1181,44 +1193,77 @@ class MeshDispatchTier:
                 if native is None:
                     continue  # no matching chromosome in this VCF
                 targets.append((key, shard, native, sid_of[key]))
-        if not targets:
+        # the delta tail: shards published since the stack was built
+        # (base fingerprint unchanged, so the stack is NOT stale — the
+        # tail just isn't in it). Deltas are small and host-served, so
+        # they ride per-shard host matching next to the single mesh
+        # launch instead of cold-starting the tier per ingest.
+        delta_targets = []
+        indexes_for = getattr(self.engine, "indexes_for", None)
+        if indexes_for is not None:
+            for ds, vcf, (shard, _di, _pl) in indexes_for(
+                sorted(dataset_ids)
+            ):
+                if (ds, vcf) in sid_of:
+                    continue  # base rows: the mesh launch serves them
+                native = shard.meta.get("chrom_native", {}).get(
+                    payload.reference_name
+                )
+                if native is None:
+                    continue
+                delta_targets.append(((ds, vcf), shard, native))
+        if not targets and not delta_targets:
             return []
         eng = self.engine.config.engine
-        specs = [spec_base] * len(targets)
-        sids = [sid for _k, _s, _n, sid in targets]
-        batcher = getattr(self.engine, "batcher", None)
-        if batcher is not None:
-            # the serving micro-batcher coalesces concurrent pod
-            # queries into the same launch and bounds the wait by the
-            # request deadline (the mesh wait IS deadline-scoped)
-            res = batcher.submit_many(
-                index,
-                specs,
-                shard_ids=sids,
-                window_cap=eng.window_cap,
-                record_cap=eng.record_cap,
-            )
-        else:
-            fault_point("kernel.launch")
-            res = index.run_mesh_queries(
-                encode_queries(specs, shard_ids=sids),
-                window_cap=eng.window_cap,
-                record_cap=eng.record_cap,
-            )
         responses = []
         gathered = 0
-        for i, (key, shard, native, _sid) in enumerate(targets):
-            if res.overflow[i] or res.n_matched[i] > eng.record_cap:
-                # window/record overflow: uncapped host matcher, the
-                # same contract as every device kernel path
-                rows = host_match_rows(shard, spec_base)
+        if targets:
+            specs = [spec_base] * len(targets)
+            sids = [sid for _k, _s, _n, sid in targets]
+            batcher = getattr(self.engine, "batcher", None)
+            if batcher is not None:
+                # the serving micro-batcher coalesces concurrent pod
+                # queries into the same launch and bounds the wait by
+                # the request deadline (the mesh wait IS deadline-scoped)
+                res = batcher.submit_many(
+                    index,
+                    specs,
+                    shard_ids=sids,
+                    window_cap=eng.window_cap,
+                    record_cap=eng.record_cap,
+                )
             else:
-                rows = res.rows[i][res.rows[i] >= 0]
-                gathered += int(rows.size)
+                fault_point("kernel.launch")
+                res = index.run_mesh_queries(
+                    encode_queries(specs, shard_ids=sids),
+                    window_cap=eng.window_cap,
+                    record_cap=eng.record_cap,
+                )
+            for i, (key, shard, native, _sid) in enumerate(targets):
+                if res.overflow[i] or res.n_matched[i] > eng.record_cap:
+                    # window/record overflow: uncapped host matcher,
+                    # the same contract as every device kernel path
+                    rows = host_match_rows(shard, spec_base)
+                else:
+                    rows = res.rows[i][res.rows[i] >= 0]
+                    gathered += int(rows.size)
+                responses.append(
+                    materialize_response(
+                        shard,
+                        rows,
+                        payload,
+                        chrom_label=native,
+                        dataset_id=key[0],
+                        vcf_location=key[1],
+                    )
+                )
+        # only the delta tail pays per-shard dispatch (host matching —
+        # deltas are small and carry no device index)
+        for key, shard, native in delta_targets:
             responses.append(
                 materialize_response(
                     shard,
-                    rows,
+                    host_match_rows(shard, spec_base),
                     payload,
                     chrom_label=native,
                     dataset_id=key[0],
@@ -1231,7 +1276,7 @@ class MeshDispatchTier:
         # the dispatch_tier note belongs to DistributedEngine.search —
         # it knows whether this query was mesh-only or "mixed" with a
         # scatter leg; writing it here would overwrite that label
-        annotate(mesh_shards=len(targets))
+        annotate(mesh_shards=len(targets), mesh_delta_tail=len(delta_targets))
         return responses
 
     def note_fallback(self) -> None:

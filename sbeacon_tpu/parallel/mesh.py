@@ -599,6 +599,14 @@ class MeshFusedIndex:
     coalesces concurrent queries for different datasets into the same
     single launch (``ops.run_queries_auto`` dispatches on the
     ``run_mesh_queries`` attribute).
+
+    Staleness contract (ingest-while-serving): the stack is built from
+    a BASE shard snapshot and keyed on the engine's
+    ``base_fingerprint()`` — delta-shard publishes leave both
+    untouched, so a standing tail never cold-starts this index; only a
+    compaction or re-ingest (a base publish) makes it stale. The owner
+    (``MeshDispatchTier`` / the engine's mesh state) serves the delta
+    tail per-shard on host next to the single mesh launch.
     """
 
     PAD_UNIT = DeviceIndex.PAD_UNIT

@@ -10,10 +10,18 @@ between ~exec_ms and ~microseconds on the soak's hot keys.
 
 Correctness model:
 
-- The key embeds ``engine.index_fingerprint()``, so any (re-)ingestion
-  — ``add_index`` / ``_publish_index`` bumps the fingerprint — makes
-  every cached entry unreachable; the engine additionally clears the
-  cache on publish so stale entries don't squat in the LRU.
+- The key embeds the engine's *per-dataset* fingerprint components
+  (``engine.cache_fingerprint(dataset_ids)``): any base publish of a
+  dataset the query touches changes the key, making its entries
+  unreachable. Delta publishes deliberately do NOT change the key —
+  freshness is enforced by **scoped invalidation** instead: a delta
+  publish calls :meth:`ResponseCache.invalidate_scope` with the new
+  rows' dataset and coordinate envelope, evicting exactly the entries
+  whose dataset set AND region overlap. A cached negative ("no such
+  variant in this bracket") dies the moment an overlapping variant
+  arrives; a cached answer for another chromosome, a disjoint bracket,
+  or an unrelated dataset keeps serving — a publish no longer resets
+  the hot-path hit rate to zero.
 - Entries are stored AND returned as copies (dataclass replace with
   fresh lists): neither a caller mutating its response nor a later hit
   can corrupt the cached value.
@@ -21,10 +29,15 @@ Correctness model:
   (empty / exists=False) response set like any other and repeats skip
   dispatch entirely — the Beacon workload is dominated by misses
   ("is this rare variant here?" is usually answered "no").
+- Publish/put races cannot resurrect stale data: ``put`` takes the
+  invalidation generation observed before the search executed and
+  re-checks it against the ring of invalidations that landed since —
+  an entry whose scope overlaps any of them (or whose generation
+  pre-dates the ring window) is dropped instead of stored.
 
 Bounded by ``max_entries`` (LRU eviction) and ``ttl_s`` (per-entry
-expiry; 0 disables). Hit/miss/eviction/expiry counters surface at
-``/metrics`` next to the batcher stats.
+expiry; 0 disables). Hit/miss/eviction/expiry/scoped-invalidation
+counters surface at ``/metrics`` next to the batcher stats.
 """
 
 from __future__ import annotations
@@ -32,7 +45,7 @@ from __future__ import annotations
 import dataclasses
 import threading
 import time
-from collections import OrderedDict
+from collections import OrderedDict, deque
 
 from .payloads import VariantQueryPayload, VariantSearchResponse
 from .telemetry import publish_event
@@ -88,22 +101,67 @@ def response_cache_key(
     )
 
 
+def response_cache_scope(payload: VariantQueryPayload) -> tuple:
+    """The entry's invalidation scope: ``(dataset_set|None, chrom,
+    (lo, hi))``. ``None`` datasets means the query ranged over every
+    loaded dataset (overlaps any publish). The coordinate span is the
+    query's full bracket envelope — conservatively wide, so a publish
+    that could possibly change the answer always overlaps it."""
+    ds = frozenset(payload.dataset_ids) if payload.dataset_ids else None
+    lo = min(payload.start_min, payload.end_min)
+    hi = max(payload.start_max, payload.end_max)
+    return (ds, payload.reference_name, (int(lo), int(hi)))
+
+
+def _scopes_overlap(entry_scope: tuple, inv_scope: tuple) -> bool:
+    """Could rows described by ``inv_scope`` change the answer cached
+    under ``entry_scope``? Conservative in every unknown direction —
+    a missing chrom/span/dataset component means "overlaps"."""
+    e_ds, e_chrom, e_span = entry_scope
+    i_ds, i_chrom, i_span = inv_scope
+    if e_ds is not None and i_ds is not None and not (e_ds & i_ds):
+        return False
+    if e_chrom and i_chrom and e_chrom != i_chrom:
+        return False
+    if e_span and i_span and (
+        e_span[1] < i_span[0] or i_span[1] < e_span[0]
+    ):
+        return False
+    return True
+
+
 class ResponseCache:
-    """Thread-safe LRU with TTL and observability counters."""
+    """Thread-safe LRU with TTL, scoped invalidation and counters."""
+
+    #: scoped invalidations remembered for the put-race check — a put
+    #: whose pre-search generation fell off this window is dropped
+    #: conservatively rather than risked
+    INVALIDATION_RING = 256
 
     def __init__(self, max_entries: int = 4096, ttl_s: float = 300.0):
         self.max_entries = max(1, int(max_entries))
         self.ttl_s = float(ttl_s)
         self._lock = threading.Lock()
-        self._entries: "OrderedDict[tuple, tuple[float, list]]" = (
-            OrderedDict()
-        )
+        # key -> (t_put, responses, scope)
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
         self._hits = 0
         self._misses = 0
         self._evictions = 0
         self._expirations = 0
         self._invalidations = 0
+        self._scoped_invalidations = 0
         self._negative_hits = 0
+        # monotonically increasing invalidation generation + the recent
+        # scoped invalidations (seq, scope) for the put-race check
+        self._gen = 0
+        self._recent_inv: deque = deque(maxlen=self.INVALIDATION_RING)
+
+    def generation(self) -> int:
+        """The invalidation generation — capture BEFORE executing a
+        search and pass to :meth:`put` so a publish that landed while
+        the search ran cannot be outrun by a stale store."""
+        with self._lock:
+            return self._gen
 
     def get(self, key: tuple) -> list[VariantSearchResponse] | None:
         """Cached response set (fresh copies) or None."""
@@ -113,7 +171,7 @@ class ResponseCache:
             if item is None:
                 self._misses += 1
                 return None
-            t_put, responses = item
+            t_put, responses, _scope = item
             if self.ttl_s > 0 and (now - t_put) > self.ttl_s:
                 del self._entries[key]
                 self._expirations += 1
@@ -125,23 +183,97 @@ class ResponseCache:
                 self._negative_hits += 1
             return [copy_response(r) for r in responses]
 
-    def put(self, key: tuple, responses: list[VariantSearchResponse]) -> None:
-        value = (time.monotonic(), [copy_response(r) for r in responses])
+    def put(
+        self,
+        key: tuple,
+        responses: list[VariantSearchResponse],
+        *,
+        scope: tuple | None = None,
+        gen: int | None = None,
+    ) -> bool:
+        """Store one entry; returns False when the store was refused
+        because an invalidation overlapping ``scope`` landed after
+        ``gen`` (the entry would be stale-at-birth)."""
+        value = (
+            time.monotonic(),
+            [copy_response(r) for r in responses],
+            scope,
+        )
         with self._lock:
+            if gen is not None and gen < self._gen:
+                # invalidations landed while the search ran: admit the
+                # entry only if EVERY one since ``gen`` provably misses
+                # its scope; a generation older than the ring window
+                # cannot be checked, so it drops conservatively
+                if self._recent_inv and self._recent_inv[0][0] > gen + 1:
+                    return False
+                newer = [s for q, s in self._recent_inv if q > gen]
+                if len(newer) < self._gen - gen:
+                    return False  # some invalidation rolled off the ring
+                for inv_scope in newer:
+                    if (
+                        scope is None
+                        or inv_scope is None
+                        or _scopes_overlap(scope, inv_scope)
+                    ):
+                        return False
             self._entries[key] = value
             self._entries.move_to_end(key)
             while len(self._entries) > self.max_entries:
                 self._entries.popitem(last=False)
                 self._evictions += 1
+        return True
 
     def invalidate(self) -> None:
-        """Drop everything (index set changed: the fingerprint in the
-        key already makes old entries unreachable, this frees them)."""
+        """Drop everything (index set changed wholesale: the
+        fingerprint in the key already makes old entries unreachable,
+        this frees them — and bumps the generation so racing puts of
+        pre-publish results are refused)."""
         with self._lock:
             dropped = len(self._entries)
             self._entries.clear()
             self._invalidations += 1
+            self._gen += 1
+            self._recent_inv.append((self._gen, None))
         publish_event("response_cache.invalidated", entries=dropped)
+
+    def invalidate_scope(
+        self,
+        dataset_ids,
+        reference_name: str | None,
+        span: tuple | None,
+    ) -> int:
+        """Evict only entries whose dataset set AND coordinate bracket
+        overlap the published rows; returns the evicted count. A None
+        ``reference_name``/``span`` means "every region" (base
+        republish); ``dataset_ids`` empty/None means "every dataset".
+        The critical correctness case is the cached negative: a "no"
+        for a bracket the new variant lands in MUST die here."""
+        inv_scope = (
+            frozenset(dataset_ids) if dataset_ids else None,
+            reference_name,
+            (int(span[0]), int(span[1])) if span else None,
+        )
+        with self._lock:
+            doomed = [
+                k
+                for k, (_t, _r, scope) in self._entries.items()
+                if scope is None or _scopes_overlap(scope, inv_scope)
+            ]
+            for k in doomed:
+                del self._entries[k]
+            self._invalidations += 1
+            self._scoped_invalidations += 1
+            self._gen += 1
+            self._recent_inv.append((self._gen, inv_scope))
+        publish_event(
+            "response_cache.invalidated",
+            entries=len(doomed),
+            scoped=True,
+            datasets=sorted(dataset_ids) if dataset_ids else [],
+            referenceName=reference_name or "",
+        )
+        return len(doomed)
 
     def stats(self) -> dict:
         with self._lock:
@@ -159,6 +291,7 @@ class ResponseCache:
                 "evictions": self._evictions,
                 "expirations": self._expirations,
                 "invalidations": self._invalidations,
+                "scoped_invalidations": self._scoped_invalidations,
             }
 
 
@@ -187,4 +320,8 @@ def register_cache_metrics(registry, supplier) -> None:
     registry.counter("response_cache.expirations", fn=field("expirations"))
     registry.counter(
         "response_cache.invalidations", fn=field("invalidations")
+    )
+    registry.counter(
+        "response_cache.scoped_invalidations",
+        fn=field("scoped_invalidations"),
     )

@@ -16,6 +16,15 @@ XLA kernel by index type) and hands each waiter its row of the results.
 Batch-shape bucketing lives inside the kernels (kernel.BATCH_TIERS /
 the scatter chunk slots), so XLA compiles one program per tier instead
 of one per batch size.
+
+Ingest-while-serving contract: the accumulators here are keyed by the
+DEVICE INDEX object (base shards, fused/mesh stacks), and delta shards
+deliberately never reach this layer — they are small, host-matched
+rows on the engine's per-target path, so a delta publish can neither
+invalidate a warm accumulator nor trigger a tier recompile. Only a
+compaction swaps a new base index in, at which point the usual lazy
+rebuild (plus the compactor's inline ``rebuild_stacks``) re-warms the
+programs off the request path.
 """
 
 from __future__ import annotations

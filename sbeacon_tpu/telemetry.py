@@ -30,7 +30,9 @@ This module is the single plane the stack wires through:
 - **Flight recorder** (:class:`EventJournal`): a bounded structured
   journal the control plane publishes transition events into (breaker
   state changes, replica failovers, hedges, rediscovery passes,
-  route-table publishes, cache invalidations, admission sheds), each
+  route-table publishes, cache invalidations — wholesale and scoped,
+  delta-shard publishes ``ingest.delta_publish``, compaction
+  ``compaction.start``/``compaction.complete``, admission sheds), each
   stamped with monotonic + wall time and the ambient trace id; served
   at ``/ops/events``. Histograms can additionally carry **exemplars**
   — the trace id of the latest observation per bucket — so a slow

@@ -1,0 +1,630 @@
+"""Ingest-while-serving (ISSUE 10): immediate delta-shard publication,
+background compaction, and region/dataset-scoped cache invalidation.
+
+The write-path contract under test:
+
+- a submitted variant is queryable the moment its slice/delta publishes
+  (read-your-writes before any compaction),
+- a delta publish does NOT demolish the query plane: the base
+  fingerprint (and therefore the fused/mesh stacks and the pod
+  dispatch tier) stays warm, and only cache entries whose dataset AND
+  region overlap the new rows are evicted — a cached negative for an
+  overlapping bracket is the critical kill,
+- base + delta serving is bit-equal (at the aggregate level each
+  granularity exposes) to a freshly rebuilt monolith,
+- a crashed compaction changes nothing observable and the next run
+  completes the fold.
+"""
+
+import random
+import threading
+import time
+from pathlib import Path
+
+import jax
+import pytest
+
+from sbeacon_tpu.config import (
+    BeaconConfig,
+    EngineConfig,
+    IngestConfig,
+    StorageConfig,
+)
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.genomics.tabix import ensure_index
+from sbeacon_tpu.genomics.vcf import VcfRecord, write_vcf
+from sbeacon_tpu.harness import faults
+from sbeacon_tpu.index.columnar import build_index, merge_shards
+from sbeacon_tpu.ingest.ledger import JobLedger
+from sbeacon_tpu.ingest.pipeline import (
+    SLICE_DISK,
+    SummarisationPipeline,
+)
+from sbeacon_tpu.ingest.service import DeltaCompactor
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+pytestmark = pytest.mark.ingest
+
+SAMPLES = ["S0", "S1"]
+
+
+def _rec(chrom: str, pos: int, ref: str = "A", alt: str = "T") -> VcfRecord:
+    return VcfRecord(
+        chrom=chrom,
+        pos=pos,
+        ref=ref,
+        alts=[alt],
+        ac=[1],
+        an=4,
+        vt="SNP",
+        genotypes=["0|1", "0|0"],
+    )
+
+
+def _shard(records, ds="dsA", vcf="a.vcf"):
+    return build_index(
+        records, dataset_id=ds, vcf_location=vcf, sample_names=SAMPLES
+    )
+
+
+def _engine(*shards, **eng_over) -> VariantEngine:
+    eng_over.setdefault("use_mesh", False)
+    eng = VariantEngine(BeaconConfig(engine=EngineConfig(**eng_over)))
+    for s in shards:
+        eng.add_index(s)
+    return eng
+
+
+def _bracket(chrom="1", lo=1, hi=1 << 29, datasets=(), gran="count",
+             include="HIT", alt="N"):
+    return VariantQueryPayload(
+        dataset_ids=list(datasets),
+        reference_name=chrom,
+        start_min=lo,
+        start_max=hi,
+        end_min=lo,
+        end_max=hi + 64,
+        alternate_bases=alt,
+        requested_granularity=gran,
+        include_datasets=include,
+    )
+
+
+def _variants(responses) -> set:
+    return {v for r in responses for v in r.variants}
+
+
+def _compactor(engine, tmp_path) -> DeltaCompactor:
+    cfg = BeaconConfig(storage=StorageConfig(root=tmp_path / "data"))
+    cfg.storage.ensure()
+    pipe = SummarisationPipeline(cfg, ledger=JobLedger(), engine=engine)
+    return DeltaCompactor(engine, pipe, pipe.ledger, cfg)
+
+
+# -- read-your-writes ---------------------------------------------------------
+
+
+def test_delta_publish_is_immediately_queryable(tmp_path):
+    """A variant arriving as a delta answers the next search — before
+    any compaction, with the base shard untouched."""
+    eng = _engine(_shard(random_records(random.Random(1), chrom="1",
+                                        n=80, n_samples=2)))
+    try:
+        miss = eng.search(_bracket(chrom="2"))
+        assert not any(r.exists for r in miss)
+        t0 = time.perf_counter()
+        eng.add_delta(_shard([_rec("2", 777)], vcf="a.vcf"))
+        hit = eng.search(_bracket(chrom="2"))
+        lag_s = time.perf_counter() - t0
+        assert any(r.exists for r in hit)
+        assert any("777" in v for v in _variants(hit))
+        # read-your-writes freshness: publish -> first hit well under
+        # the 1 s acceptance bound (no rebuild in the path)
+        assert lag_s < 1.0, f"delta->hit took {lag_s:.2f}s"
+        assert eng.delta_stats()["dsA"]["shards"] == 1
+    finally:
+        eng.close()
+
+
+def test_streamed_summarisation_queryable_before_base_publish(tmp_path):
+    """The pipeline's streaming mode: slices publish as deltas during
+    the scan; with deferred base publish the data serves BEFORE any
+    base shard exists for the key (compaction later folds it)."""
+    rng = random.Random(3)
+    recs = random_records(rng, chrom="1", n=400, n_samples=2)
+    vcf = tmp_path / "s.vcf.gz"
+    write_vcf(vcf, recs, sample_names=SAMPLES)
+    ensure_index(vcf)
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "data"),
+        engine=EngineConfig(use_mesh=False),
+        ingest=IngestConfig(
+            min_task_time=1e-6,
+            scan_rate=1e6,
+            dispatch_cost=1e-7,
+            max_concurrency=1000,
+            workers=2,
+            stream_deltas=True,
+            defer_base_publish=True,
+            compact_interval_s=0.0,
+        ),
+    )
+    cfg.storage.ensure()
+    eng = VariantEngine(cfg)
+    pipe = SummarisationPipeline(cfg, ledger=JobLedger(), engine=eng)
+    try:
+        stats = pipe.summarise_dataset("dsA", [str(vcf)])
+        assert stats["callCount"] > 0
+        # base publish deferred: no base shard, a standing delta tail
+        assert not eng.has_index("dsA", str(vcf))
+        assert eng.delta_stats()["dsA"]["shards"] >= 1
+        got = eng.search(_bracket(chrom="1", alt="N"))
+        want = {r.pos for r in recs
+                if any(len(a) == 1 and a.upper() in "ACGTN"
+                       for a in r.alts)}
+        assert any(r.exists for r in got) == bool(want)
+        # fold through the compactor: identical answers, empty tail
+        pre = _variants(eng.search(_bracket(chrom="1")))
+        comp = DeltaCompactor(eng, pipe, pipe.ledger, cfg)
+        folded = comp.run_once()
+        assert ("dsA", str(vcf)) in folded
+        assert eng.has_index("dsA", str(vcf))
+        assert eng.delta_stats() == {}
+        assert _variants(eng.search(_bracket(chrom="1"))) == pre
+    finally:
+        eng.close()
+
+
+# -- scoped cache invalidation ------------------------------------------------
+
+
+def test_negative_cache_evicted_by_overlapping_delta():
+    """THE correctness case: a cached 'no' for a bracket must die the
+    moment a variant lands inside it."""
+    eng = _engine(_shard([_rec("1", 1000)]))
+    try:
+        neg = _bracket(chrom="1", lo=5000, hi=6000)
+        assert not any(r.exists for r in eng.search(neg))
+        assert not any(r.exists for r in eng.search(neg))  # cached no
+        assert eng.cache_stats()["negative_hits"] == 1
+        eng.add_delta(_shard([_rec("1", 5500)], vcf="a.vcf"))
+        got = eng.search(neg)
+        assert any(r.exists for r in got), (
+            "cached negative survived an overlapping delta publish"
+        )
+    finally:
+        eng.close()
+
+
+def test_nonoverlapping_entries_survive_delta_publish():
+    """A delta publish evicts ONLY overlapping entries: other regions,
+    other chromosomes and other datasets keep their warm hits."""
+    sA = _shard(
+        [_rec("1", 1000), _rec("2", 1000)], ds="dsA", vcf="a.vcf"
+    )
+    sB = _shard([_rec("1", 1000)], ds="dsB", vcf="b.vcf")
+    eng = _engine(sA, sB)
+    try:
+        q_far = _bracket(chrom="1", lo=900, hi=1100, datasets=["dsA"])
+        q_chr2 = _bracket(chrom="2", lo=900, hi=1100, datasets=["dsA"])
+        q_dsB = _bracket(chrom="1", lo=1, hi=1 << 29, datasets=["dsB"])
+        for q in (q_far, q_chr2, q_dsB):
+            eng.search(q)  # prime
+        hits0 = eng.cache_stats()["hits"]
+        # delta for dsA chr1 FAR from q_far's bracket
+        eng.add_delta(_shard([_rec("1", 500_000)], ds="dsA",
+                             vcf="a.vcf"))
+        # non-overlapping entries still hit...
+        for q in (q_chr2, q_dsB, q_far):
+            eng.search(q)
+        assert eng.cache_stats()["hits"] == hits0 + 3
+        # ...and an overlapping bracket sees the new variant
+        q_cover = _bracket(chrom="1", lo=400_000, hi=600_000,
+                           datasets=["dsA"])
+        assert any("500000" in v
+                   for v in _variants(eng.search(q_cover)))
+    finally:
+        eng.close()
+
+
+def test_all_dataset_entries_scope_evicted_by_region():
+    """Entries for dataset_ids=[] (every dataset) overlap any dataset's
+    publish — but still survive when the REGION is disjoint."""
+    eng = _engine(_shard([_rec("1", 1000)]))
+    try:
+        q_all_chr2 = _bracket(chrom="2")
+        eng.search(q_all_chr2)
+        hits0 = eng.cache_stats()["hits"]
+        eng.add_delta(_shard([_rec("1", 2000)], vcf="a.vcf"))
+        eng.search(q_all_chr2)  # chr2 bracket: disjoint from chr1 delta
+        assert eng.cache_stats()["hits"] == hits0 + 1
+        q_all_chr1 = _bracket(chrom="1")
+        assert any("2000" in v
+                   for v in _variants(eng.search(q_all_chr1)))
+    finally:
+        eng.close()
+
+
+def test_scoped_invalidation_toggle_off_restores_wholesale_clear():
+    eng = _engine(
+        _shard([_rec("1", 1000)]), scoped_invalidation=False
+    )
+    try:
+        eng.search(_bracket(chrom="2"))
+        assert eng.cache_stats()["entries"] == 1
+        eng.add_delta(_shard([_rec("1", 9000)], vcf="a.vcf"))
+        stats = eng.cache_stats()
+        assert stats["entries"] == 0  # wholesale clear
+        assert stats["scoped_invalidations"] == 0
+    finally:
+        eng.close()
+
+
+def test_put_race_guard_refuses_stale_store():
+    """A search that raced an overlapping invalidation must not store
+    its pre-publish result; a non-overlapping racer may."""
+    from sbeacon_tpu.response_cache import ResponseCache
+
+    cache = ResponseCache()
+    gen = cache.generation()
+    cache.invalidate_scope(["dsA"], "1", (100, 200))
+    scope_overlap = (frozenset({"dsA"}), "1", (150, 250))
+    scope_clear = (frozenset({"dsB"}), "2", (1, 50))
+    assert cache.put(("k1",), [], scope=scope_overlap, gen=gen) is False
+    assert cache.put(("k2",), [], scope=scope_clear, gen=gen) is True
+    assert cache.put(("k3",), [], scope=scope_overlap) is True  # no gen
+
+
+# -- parity -------------------------------------------------------------------
+
+
+def test_base_plus_delta_matches_monolith_across_granularities():
+    rng = random.Random(11)
+    recs = random_records(rng, chrom="1", n=300, n_samples=2)
+    cut1, cut2 = len(recs) // 2, 3 * len(recs) // 4
+    base = _shard(recs[:cut1])
+    d1 = _shard(recs[cut1:cut2], vcf="a.vcf")
+    d2 = _shard(recs[cut2:], vcf="a.vcf")
+    split = _engine(base)
+    split.add_delta(d1)
+    split.add_delta(d2)
+    mono = _engine(
+        _shard(recs)
+    )
+    try:
+        for gran in ("boolean", "count", "record"):
+            for alt in (None, "N", "T"):
+                q = _bracket(chrom="1", gran=gran, alt=alt,
+                             include="HIT")
+                rs, rm = split.search(q), mono.search(q)
+                assert any(r.exists for r in rs) == any(
+                    r.exists for r in rm
+                ), (gran, alt)
+                if gran == "boolean":
+                    continue  # per-response truncation may differ
+                assert _variants(rs) == _variants(rm), (gran, alt)
+                assert sum(r.call_count for r in rs) == sum(
+                    r.call_count for r in rm
+                ), (gran, alt)
+                assert sum(r.all_alleles_count for r in rs) == sum(
+                    r.all_alleles_count for r in rm
+                ), (gran, alt)
+    finally:
+        split.close()
+        mono.close()
+
+
+def test_compaction_preserves_answers_and_retires_tail(tmp_path):
+    rng = random.Random(12)
+    recs = random_records(rng, chrom="1", n=200, n_samples=2)
+    eng = _engine(_shard(recs[:120]))
+    eng.add_delta(_shard(recs[120:160], vcf="a.vcf"))
+    eng.add_delta(_shard(recs[160:], vcf="a.vcf"))
+    try:
+        q = _bracket(chrom="1")
+        pre = _variants(eng.search(q))
+        base_fp = eng.base_fingerprint()
+        comp = _compactor(eng, tmp_path)
+        folded = comp.run_once()
+        assert set(folded) == {("dsA", "a.vcf")}
+        assert folded[("dsA", "a.vcf")] > 0
+        assert eng.delta_stats() == {}
+        assert eng.base_fingerprint() != base_fp
+        assert _variants(eng.search(q)) == pre
+        assert comp.metrics()["runs"] == 1
+    finally:
+        eng.close()
+
+
+# -- crash resilience ---------------------------------------------------------
+
+
+@pytest.mark.resilience
+def test_crashed_compaction_keeps_serving_then_completes(tmp_path):
+    """An injected ``compaction.fold`` crash must leave base + deltas
+    serving correct, duplicate-free results; the NEXT run completes
+    the fold with identical answers."""
+    rng = random.Random(13)
+    recs = random_records(rng, chrom="1", n=150, n_samples=2)
+    eng = _engine(_shard(recs[:100]))
+    eng.add_delta(_shard(recs[100:], vcf="a.vcf"))
+    try:
+        q = _bracket(chrom="1")
+        pre = _variants(eng.search(q))
+        pre_calls = sum(r.call_count for r in eng.search(q))
+        comp = _compactor(eng, tmp_path)
+        faults.install(
+            {
+                "seed": 7,
+                "rules": [
+                    {
+                        "site": "compaction.fold",
+                        "kind": "error",
+                        "rate": 1.0,
+                        "count": 1,
+                    }
+                ],
+            }
+        )
+        try:
+            out = comp.run_once()
+        finally:
+            faults.uninstall()
+        assert out == {}  # the fold crashed, nothing published
+        assert comp.metrics()["failures"] == 1
+        # base + deltas still serve, duplicate-free
+        assert eng.delta_stats()["dsA"]["shards"] == 1
+        assert _variants(eng.search(q)) == pre
+        assert sum(r.call_count for r in eng.search(q)) == pre_calls
+        # next run completes the fold
+        folded = comp.run_once()
+        assert ("dsA", "a.vcf") in folded
+        assert eng.delta_stats() == {}
+        assert _variants(eng.search(q)) == pre
+        assert sum(r.call_count for r in eng.search(q)) == pre_calls
+    finally:
+        eng.close()
+
+
+@pytest.mark.resilience
+def test_crash_after_persist_before_publish_recovers(tmp_path):
+    """The other side of the durability seam: merged artifact saved,
+    engine swap crashed — deltas keep serving and the retry adopts the
+    persisted artifact."""
+    rng = random.Random(14)
+    recs = random_records(rng, chrom="1", n=120, n_samples=2)
+    eng = _engine(_shard(recs[:80]))
+    eng.add_delta(_shard(recs[80:], vcf="a.vcf"))
+    try:
+        q = _bracket(chrom="1")
+        pre = _variants(eng.search(q))
+        comp = _compactor(eng, tmp_path)
+        faults.install(
+            {
+                "seed": 7,
+                "rules": [
+                    {
+                        "site": "compaction.fold",
+                        "kind": "error",
+                        "rate": 1.0,
+                        "count": 1,
+                        "match": ":publish",
+                    }
+                ],
+            }
+        )
+        try:
+            out = comp.run_once()
+        finally:
+            faults.uninstall()
+        assert out == {}
+        # the merged artifact IS on disk, but the swap never happened
+        assert comp.pipeline.shard_path("dsA", "a.vcf").exists()
+        assert eng.delta_stats()["dsA"]["shards"] == 1
+        assert _variants(eng.search(q)) == pre
+        folded = comp.run_once()
+        assert ("dsA", "a.vcf") in folded
+        assert _variants(eng.search(q)) == pre
+    finally:
+        eng.close()
+
+
+# -- concurrency --------------------------------------------------------------
+
+
+def test_concurrent_queries_during_continuous_ingest():
+    """Queries racing a stream of delta publishes never error and end
+    fully consistent once the stream stops."""
+    eng = _engine(_shard([_rec("1", 100)]))
+    errors: list = []
+    stop = threading.Event()
+
+    def publisher():
+        for i in range(20):
+            eng.add_delta(
+                _shard([_rec("1", 10_000 + 100 * i)], vcf="a.vcf")
+            )
+            time.sleep(0.002)
+        stop.set()
+
+    def querier():
+        while not stop.is_set():
+            try:
+                eng.search(_bracket(chrom="1"))
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+                return
+
+    threads = [threading.Thread(target=publisher)] + [
+        threading.Thread(target=querier) for _ in range(4)
+    ]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=30)
+        assert not errors, errors[:1]
+        got = _variants(eng.search(_bracket(chrom="1")))
+        want_pos = {100} | {10_000 + 100 * i for i in range(20)}
+        assert {int(v.split("\t")[1]) for v in got} == want_pos
+        assert eng.delta_stats()["dsA"]["shards"] == 20
+    finally:
+        eng.close()
+
+
+# -- warm stacks across publishes --------------------------------------------
+
+
+def test_fingerprint_split_and_epoch_monotonicity():
+    eng = _engine(_shard([_rec("1", 1000)]))
+    try:
+        base_fp = eng.base_fingerprint()
+        full_fp = eng.index_fingerprint()
+        cache_other = eng.cache_fingerprint(["dsB"])
+        eng.add_delta(_shard([_rec("1", 2000)], vcf="a.vcf"))
+        assert eng.base_fingerprint() == base_fp
+        assert eng.index_fingerprint() != full_fp
+        assert eng.cache_fingerprint(["dsB"]) == cache_other
+        # fold via a base publish carrying the folded epoch
+        merged = merge_shards(
+            [_shard([_rec("1", 1000)]),
+             _shard([_rec("1", 2000)], vcf="a.vcf")]
+        )
+        merged.meta.update(
+            dataset_id="dsA", vcf_location="a.vcf", delta_epoch=1
+        )
+        eng.add_index(merged)
+        assert eng.delta_stats() == {}
+        assert eng.base_fingerprint() != base_fp
+        # epochs continue past the folded one (restart monotonicity)
+        assert eng.add_delta(
+            _shard([_rec("1", 3000)], vcf="a.vcf")
+        ) == 2
+    finally:
+        eng.close()
+
+
+def test_fused_stack_stays_clean_across_delta_publish():
+    """The engine's fused cross-shard stack is NOT dirtied by a delta
+    publish (base fingerprint stable) — and queries still see delta
+    rows via the per-shard tail."""
+    shards = [
+        _shard(random_records(random.Random(20 + i), chrom="1", n=120,
+                              n_samples=2),
+               ds=f"d{i}", vcf=f"v{i}")
+        for i in range(3)
+    ]
+    eng = _engine(*shards)
+    try:
+        eng.warmup()
+        assert eng._fused_dirty is False
+        eng.add_delta(_shard([_rec("1", 123_456)], ds="d0", vcf="v0"))
+        assert eng._fused_dirty is False, (
+            "delta publish dirtied the fused stack"
+        )
+        got = eng.search(
+            _bracket(chrom="1", datasets=["d0", "d1", "d2"])
+        )
+        assert any("123456" in v for v in _variants(got))
+    finally:
+        eng.close()
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="mesh tier needs >=2 devices (forced-host CI mesh)",
+)
+def test_mesh_dispatch_tier_warm_across_delta_then_stale_after_fold(
+    tmp_path,
+):
+    from sbeacon_tpu.parallel.dispatch import MeshDispatchTier
+
+    shards = [
+        _shard(random_records(random.Random(30 + i), chrom="1", n=150,
+                              n_samples=2),
+               ds=f"d{i}", vcf=f"v{i}")
+        for i in range(3)
+    ]
+    eng = _engine(*shards)
+    tier = MeshDispatchTier(eng, min_shards=2)
+    try:
+        assert tier.warmup() > 0
+        pay = _bracket(chrom="1", datasets=["d0", "d1", "d2"])
+        assert tier.resolve(["d0", "d1", "d2"], pay) == {
+            "d0", "d1", "d2"
+        }
+        before = tier.stats()["dispatches"]
+        # delta publish: tier must stay READY (no cold rebuild)...
+        eng.add_delta(_shard([_rec("1", 424_242)], ds="d0", vcf="v0"))
+        assert tier.resolve(["d0", "d1", "d2"], pay) == {
+            "d0", "d1", "d2"
+        }, "delta publish cold-started the mesh tier"
+        got = tier.search(pay, {"d0", "d1", "d2"})
+        assert tier.stats()["dispatches"] == before + 1
+        # ...and the delta tail rides along, host-served
+        assert any("424242" in v for v in _variants(got))
+        # a FOLD (base publish) is the staleness event: the tier goes
+        # cold once and background-rebuilds against the new base
+        comp = _compactor(eng, tmp_path)
+        folded = comp.run_once()
+        assert ("d0", "v0") in folded
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            if tier.resolve(["d0", "d1", "d2"], pay):
+                break
+            time.sleep(0.1)
+        assert tier.resolve(["d0", "d1", "d2"], pay), (
+            "tier never rebuilt after compaction"
+        )
+        got = tier.search(pay, {"d0", "d1", "d2"})
+        assert any("424242" in v for v in _variants(got))
+    finally:
+        eng.close()
+
+
+# -- slice temp-disk ----------------------------------------------------------
+
+
+def test_slice_files_deleted_as_folded_and_gauge_returns_to_zero(
+    tmp_path,
+):
+    rng = random.Random(40)
+    recs = []
+    for chrom in ("1", "2", "3"):
+        recs.extend(random_records(rng, chrom=chrom, n=900, n_samples=2))
+    vcf = tmp_path / "big.vcf.gz"
+    write_vcf(vcf, recs, sample_names=SAMPLES)
+    ensure_index(vcf)
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "data"),
+        engine=EngineConfig(use_mesh=False),
+        ingest=IngestConfig(
+            min_task_time=1e-6,
+            scan_rate=1e6,
+            dispatch_cost=1e-7,
+            max_concurrency=1000,
+            workers=1,  # deterministic: one slice on disk at a time
+            stream_deltas=True,
+        ),
+    )
+    cfg.storage.ensure()
+    eng = VariantEngine(cfg)
+    pipe = SummarisationPipeline(cfg, ledger=JobLedger(), engine=eng)
+    from sbeacon_tpu.ingest.planner import plan_slices
+
+    plan = plan_slices(ensure_index(vcf), cfg.ingest)
+    assert len(plan.slices) >= 3, "fixture must be multi-slice"
+    SLICE_DISK.reset()
+    try:
+        pipe.summarise_dataset("dsA", [str(vcf)])
+        stats = SLICE_DISK.stats()
+        assert stats["current"] == 0  # everything folded + deleted
+        assert stats["peak"] > 0
+        # streaming + serial workers: slices die as they fold, so the
+        # peak is far below the sum of all slices that existed
+        final = pipe.shard_path("dsA", str(vcf))
+        assert final.exists()
+        assert not pipe._slice_dir("dsA", str(vcf)).exists()
+    finally:
+        eng.close()

@@ -110,6 +110,54 @@ def test_warm_cache_hit_is_zero_launches():
         eng.close()
 
 
+@pytest.mark.perf_smoke
+@pytest.mark.ingest
+def test_delta_publish_keeps_warm_cache_and_fused_stack():
+    """Ingest-while-serving (ISSUE 10): a delta publish must NOT reset
+    the warm query plane — a cached query whose region/dataset does not
+    overlap the new rows still answers with ZERO launches, and the
+    fused stack stays clean (no rebuild, next cold query is still one
+    fused launch)."""
+    from sbeacon_tpu.genomics.vcf import VcfRecord
+
+    eng, _shards = _engine()
+    try:
+        eng.warmup()
+        first = eng.search(_payload())  # cached (chr1 bracket, d0-d3)
+        delta = build_index(
+            [
+                VcfRecord(
+                    chrom="2",
+                    pos=777,
+                    ref="A",
+                    alts=["T"],
+                    ac=[1],
+                    an=4,
+                    vt="SNP",
+                    genotypes=["0|1", "0|0"],
+                )
+            ],
+            dataset_id="d0",
+            vcf_location="v0",
+            sample_names=["S0", "S1"],
+        )
+        eng.add_delta(delta)  # chr2: disjoint from the cached bracket
+        assert eng._fused_dirty is False, (
+            "delta publish dirtied the fused stack"
+        )
+        n0 = _launches()
+        again = eng.search(_payload())
+        assert _launches() - n0 == 0, (
+            "delta publish dropped a non-overlapping cache entry"
+        )
+        assert [(r.dataset_id, r.call_count) for r in first] == [
+            (r.dataset_id, r.call_count) for r in again
+        ]
+        assert eng.cache_stats()["scoped_invalidations"] >= 1
+    finally:
+        eng.close()
+
+
 # -- coordinator-worker data plane (ISSUE 5) ----------------------------------
 
 

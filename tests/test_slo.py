@@ -325,8 +325,11 @@ def test_debug_status_schema_and_diagnosis(app):
     assert status == 200
     assert set(doc) == {
         "ready", "beaconId", "slo", "breakers", "routing", "queues",
-        "stages", "events", "diagnosis",
+        "ingest", "stages", "events", "diagnosis",
     }
+    # ingest-while-serving rollup (ISSUE 10): delta-tail depth +
+    # compactor counters; empty tails render as {}
+    assert set(doc["ingest"]) <= {"deltaTails", "compactor"}
     assert doc["ready"] is True
     assert set(doc["queues"]) == {
         "admission", "shaping", "runner", "batcher",

@@ -186,6 +186,12 @@ GOLDEN_METRICS = [
     "response_cache.evictions",
     "response_cache.expirations",
     "response_cache.invalidations",
+    "response_cache.scoped_invalidations",
+    "ingest.delta_publishes",
+    "ingest.delta_shards",
+    "ingest.slice_disk_bytes",
+    "compaction.runs",
+    "compaction.folded_rows",
     "transport.conn.opened",
     "transport.conn.reused",
     "transport.conn.evicted",
