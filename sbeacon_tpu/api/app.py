@@ -19,10 +19,12 @@ tests, batch drivers) is external.
 from __future__ import annotations
 
 import hmac
+import json
 import math
 import time
 from pathlib import Path
 
+from ..accounting import CostAccounting, disabled_snapshot, query_shape
 from ..config import BeaconConfig, StorageConfig
 from ..engine import VariantEngine
 from ..ingest import IngestService
@@ -40,13 +42,14 @@ from ..resilience import (
     register_admission_metrics,
     register_breaker_metrics,
 )
-from ..shaping import TrafficShaper
+from ..shaping import TrafficShaper, requested_granularity
 from ..slo import SloEngine
 from ..telemetry import (
     MetricsRegistry,
     RequestContext,
     SlowQueryLog,
     annotate,
+    current_context,
     journal,
     profiler,
     request_context,
@@ -112,6 +115,8 @@ def _header(headers: dict | None, name: str) -> str | None:
         if k.lower() == name:
             return v
     return None
+
+
 
 
 def _authorization_header(headers: dict) -> str:
@@ -227,9 +232,33 @@ class BeaconApp:
 
             set_hedging_enabled(enabled)
 
+        # cost accounting (accounting.py): every tracked request's
+        # CostVector folds into the per-(tenant, lane, query-shape)
+        # table served at /ops/costs; tenant cardinality reuses
+        # shaping's cap. Built BEFORE the shaper so the cost-aware DRR
+        # seam (BEACON_COST_DRR) can charge measured shape costs.
+        obs_cfg = self.config.observability
+        if getattr(obs_cfg, "cost_accounting", True):
+            self.accounting = CostAccounting(
+                window_s=getattr(obs_cfg, "cost_window_s", 300.0),
+                max_tenants=self.config.shaping.max_tenants,
+            )
+        else:
+            self.accounting = None
         self.shaping = TrafficShaper.from_config(
-            self.config, hedge_control=_hedge_control
+            self.config,
+            hedge_control=_hedge_control,
+            cost_charge_fn=(
+                self.accounting.drr_charge
+                if self.accounting is not None
+                else None
+            ),
         )
+        # the background compactor runs off any request context: book
+        # its fold cost under the 'system' tenant via the explicit hook
+        compactor = getattr(self.ingest, "compactor", None)
+        if compactor is not None and self.accounting is not None:
+            compactor.accounting = self.accounting
         # readiness flag: constructed apps are servable; a deployment
         # may clear it during reload/drain so load balancers back off
         self.ready = True
@@ -247,7 +276,9 @@ class BeaconApp:
         # outcome; served at /slo and as slo.* gauges. The brownout
         # ladder subscribes to its breach signal: sustained burn steps
         # degradation up, sustained recovery steps it back down.
-        self.slo = SloEngine.from_config(obs)
+        self.slo = SloEngine.from_config(
+            obs, max_tenants=self.config.shaping.max_tenants
+        )
         self.slo.add_breach_listener(self.shaping.on_slo_signal)
         # flight recorder: the process journal was built from env
         # defaults at import; the config tier re-applies here (like
@@ -316,6 +347,11 @@ class BeaconApp:
             "end-to-end request latency per route",
             label="route",
             exemplars=True,
+            # the route label set is bounded by _route_label but its
+            # legitimate cardinality (entity heads x sub-routes) tops
+            # the registry's default 64-value guard — raise the cap
+            # instead of collapsing real routes to "other"
+            max_label_values=128,
         )
         reg.counter(
             "request.slow_queries",
@@ -323,6 +359,12 @@ class BeaconApp:
             fn=lambda: self.slow_log.count(),
         )
         self.slo.register_metrics(reg)
+        if self.accounting is not None:
+            self.accounting.register_metrics(reg)
+        else:
+            # catalogue stability: the cost.* series exist (zeros) even
+            # with accounting off, like every other optional plane
+            CostAccounting().register_metrics(reg)
         reg.counter(
             "events.published",
             "control-plane events published to the flight recorder",
@@ -409,7 +451,11 @@ class BeaconApp:
             # named labels — /ops/<anything-else> must collapse like
             # any other unknown path or a scanner mints series
             label = f"{head}.{parts[1]}"
-            return label if label in ("ops.events", "debug.status") else "other"
+            return (
+                label
+                if label in ("ops.events", "ops.costs", "debug.status")
+                else "other"
+            )
         sub = parts[-1]
         if sub in ("filtering_terms", "g_variants", "biosamples",
                    "individuals", "runs", "analyses"):
@@ -448,13 +494,49 @@ class BeaconApp:
         self._req_latency.observe(
             elapsed_ms, label_value=route, exemplar=ctx.trace_id
         )
-        self.slo.record(route, status, elapsed_ms)
+        tenant = ctx.notes.get("tenant")
+        self.slo.record(route, status, elapsed_ms, tenant=tenant)
+        # cost accounting: fold this request's CostVector into the
+        # (tenant, lane, shape) table. Probe/diagnostic routes are
+        # excluded exactly like SLO budgets — a /metrics scrape is not
+        # tenant work. Response bytes are measured here (the one place
+        # the final payload exists); the serialization is the same one
+        # the transport pays, bounded to tracked routes only.
+        if self.accounting is not None and self.slo.tracked(route):
+            cost = ctx.cost
+            if isinstance(payload, dict):
+                try:
+                    cost.add(
+                        response_bytes=len(
+                            json.dumps(payload, default=str)
+                        )
+                    )
+                except (TypeError, ValueError):
+                    pass
+            # seal BEFORE snapshotting: late charges (a launch
+            # finishing after this request 504ed, a losing hedge leg's
+            # RTT) redirect to the unattributed residue, and a charge
+            # racing this very fold cannot fall between the snapshot
+            # and the seal — it lands in exactly one of the two sides
+            cost.seal()
+            self.accounting.record(
+                tenant or "anon",
+                ctx.notes.get("lane") or "interactive",
+                query_shape(route, ctx.notes.get("granularity")),
+                cost.snapshot(),
+            )
+        notes = ctx.notes
+        if ctx.cost.nonzero():
+            # slow-query records carry the cost decomposition: a tail
+            # is attributable to device time vs host scan vs worker
+            # RTT without cross-referencing /ops/costs
+            notes = {**notes, "cost": ctx.cost.as_dict()}
         self.slow_log.maybe_record(
             trace_id=ctx.trace_id,
             route=route,
             status=status,
             elapsed_ms=elapsed_ms,
-            notes=ctx.notes,
+            notes=notes,
         )
         if isinstance(payload, dict):
             meta = payload.get("meta")
@@ -487,6 +569,7 @@ class BeaconApp:
                     "metrics",
                     "slo",
                     "ops/events",
+                    "ops/costs",
                     "debug/status",
                 ):
                     # probes/metrics AND the self-diagnosis surfaces
@@ -507,9 +590,20 @@ class BeaconApp:
                 # scope wraps the queue wait so it stays bounded
                 tenant = self.shaping.tenant_of(headers)
                 lane = self.shaping.lane_of(head, query_params, body)
+                granularity = requested_granularity(query_params, body)
                 annotate(tenant=tenant, lane=lane)
+                if granularity:
+                    annotate(granularity=granularity)
+                # the query-shape key (route x granularity): the same
+                # key the accounting fold uses, so the cost-aware DRR
+                # (BEACON_COST_DRR) charges admission with the measured
+                # cost of exactly this shape
+                ctx = current_context()
+                shape = query_shape(
+                    ctx.route if ctx is not None else head, granularity
+                )
                 with deadline_scope(deadline), self.shaping.admit(
-                    tenant, lane
+                    tenant, lane, shape
                 ), self.admission.admit():
                     return self._route(
                         method.upper(), path, query_params, body
@@ -592,10 +686,18 @@ class BeaconApp:
             return (200 if self.ready else 503), body
         if head == "slo":
             # per-route objectives + multi-window burn rates (the JSON
-            # twin of the slo.* Prometheus gauges)
-            return 200, self.slo.snapshot()
+            # twin of the slo.* Prometheus gauges); ?tenant=<id> scopes
+            # the same document to one tenant's isolated burn rings
+            want_tenant = (query_params or {}).get("tenant")
+            return 200, self.slo.snapshot(tenant=want_tenant or None)
         if head == "ops/events":
             return self._ops_events(query_params)
+        if head == "ops/costs":
+            # the tenant accounting plane's rollup: top tenants by
+            # cost unit, per-shape mean/p99, attribution ratio
+            if self.accounting is None:
+                return 200, disabled_snapshot()
+            return 200, self.accounting.snapshot()
         if head == "debug/status":
             return 200, self._debug_status()
         # /metrics: content negotiation — ?format=openmetrics or an
@@ -711,6 +813,14 @@ class BeaconApp:
             for u, w in workers.items()
             if w.get("medianRttMs") is not None
         }
+        # cost-accounting rollup + the two attribution diagnoses: an
+        # operator staring at a breached SLO sees WHO is burning the
+        # budget in the same document that names the breach
+        costs = (
+            self.accounting.debug()
+            if self.accounting is not None
+            else {"enabled": False}
+        )
         return {
             "ready": bool(self.ready),
             "beaconId": self.config.info.beacon_id,
@@ -720,6 +830,7 @@ class BeaconApp:
             "queues": queues,
             "ingest": ingest,
             "stages": stages,
+            "costs": costs,
             "events": {
                 "lastSeq": journal.last_seq(),
                 "published": journal.published(),
@@ -735,6 +846,8 @@ class BeaconApp:
                 "slowestWorker": (
                     max(rtts, key=rtts.get) if rtts else None
                 ),
+                "costliestTenant": costs.get("costliestTenant"),
+                "costliestShape": costs.get("costliestShape"),
             },
         }
 

@@ -373,6 +373,13 @@ class ShapingConfig:
     brownout_md_factor: float = 0.5
     brownout_ai_step: float = 0.25
     brownout_min_scale: float = 0.125
+    # cost-aware DRR (accounting.py scheduling seam): the fair queue
+    # charges a grant the MEASURED mean cost of its query shape
+    # (normalized to the lane mean, clamped [0.25, 2.0]) instead of
+    # the flat 1-per-request deficit. Off (default) keeps the flat
+    # charge byte-identical — observability first, scheduling proven
+    # in the config15 bench probe before it defaults on.
+    cost_drr: bool = False
 
 
 @dataclasses.dataclass(frozen=True)
@@ -406,6 +413,13 @@ class ObservabilityConfig:
     Flight recorder (telemetry.EventJournal, served at ``/ops/events``):
     event_journal: enables control-plane event publication.
     event_journal_size: events kept in the bounded ring.
+
+    Cost accounting (accounting.py, served at ``/ops/costs``):
+    cost_accounting: fold every tracked request's CostVector into the
+      per-(tenant, lane, query-shape) table + the ``cost.*`` series.
+    cost_window_s: the decaying window the per-shape mean cost (and
+      the DRR charge hook) is computed over.
+    Tenant cardinality reuses shaping's ``max_tenants`` cap.
     """
 
     slow_query_ms: float = 1000.0
@@ -418,6 +432,8 @@ class ObservabilityConfig:
     slo_alert_burn_rate: float = 14.4
     event_journal: bool = True
     event_journal_size: int = 1024
+    cost_accounting: bool = True
+    cost_window_s: float = 300.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -635,6 +651,12 @@ class BeaconConfig:
             obs_over["event_journal"] = (
                 env["BEACON_EVENT_JOURNAL_ENABLED"].lower() not in _off
             )
+        if "BEACON_COST_ACCOUNTING" in env:
+            obs_over["cost_accounting"] = (
+                env["BEACON_COST_ACCOUNTING"].lower() not in _off
+            )
+        if "BEACON_COST_WINDOW_S" in env:
+            obs_over["cost_window_s"] = float(env["BEACON_COST_WINDOW_S"])
         observability = ObservabilityConfig(**obs_over)
         sh_over: dict = {}
         _sh_env = {
@@ -658,6 +680,8 @@ class BeaconConfig:
             sh_over["enabled"] = env["BEACON_SHAPING"].lower() not in _off
         if "BEACON_BROWNOUT" in env:
             sh_over["brownout"] = env["BEACON_BROWNOUT"].lower() not in _off
+        if "BEACON_COST_DRR" in env:
+            sh_over["cost_drr"] = env["BEACON_COST_DRR"].lower() not in _off
         shaping = ShapingConfig(**sh_over)
         return BeaconConfig(
             info=info,

@@ -43,7 +43,14 @@ from .response_cache import (
     response_cache_key,
     response_cache_scope,
 )
-from .telemetry import annotate, percentiles, publish_event
+from .telemetry import (
+    annotate,
+    charge_cost,
+    current_context,
+    percentiles,
+    publish_event,
+    request_context,
+)
 from .utils.chrom import chromosome_code
 from .utils.trace import span
 
@@ -112,6 +119,10 @@ def host_match_rows(
     b = int(np.searchsorted(pos, q.start_max, side="right"))
     if a >= b:
         return np.empty(0, dtype=np.int64)
+    # cost attribution (ISSUE 11): the candidate bracket is exactly
+    # the rows this scan walks — charged to the ambient request's
+    # CostVector (or the unattributed residue off-request)
+    charge_cost(host_rows=b - a)
     sl = slice(lo + a, lo + b)
     idx = np.arange(lo + a, lo + b)
 
@@ -1698,6 +1709,19 @@ class VariantEngine:
             targets.append((ds, vcf, shard, dindex, planes, native))
         if not targets:
             return []
+        # cost attribution: delta-tail shards walked by this query
+        # (their serve-list labels carry the '#d<epoch>' suffix) — the
+        # per-shard host-dispatch tax continuous ingest imposes, now
+        # attributable to the tenant that pays it
+        n_delta = sum(1 for t in targets if "#d" in t[1])
+        if n_delta:
+            charge_cost(delta_shards=n_delta)
+        # the submitting request's context: _one_target runs on the
+        # scatter pool, whose threads do not inherit thread-locals —
+        # re-installing it makes every charge (host rows, batcher
+        # device share) and the batcher's lane note attribute to the
+        # request instead of the unattributed residue
+        req_ctx = current_context()
 
         # mesh serving covers the BASE shard snapshot it was built from;
         # the delta tail (and any racing republish) is excluded and
@@ -1748,6 +1772,10 @@ class VariantEngine:
         )
 
         def _one_target(target):
+            with request_context(req_ctx):
+                return _one_target_inner(target)
+
+        def _one_target_inner(target):
             ds, vcf, shard, dindex, planes, native = target
             selected_idx = None
             fused = None

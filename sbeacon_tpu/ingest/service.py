@@ -62,6 +62,12 @@ class DeltaCompactor:
         self.pipeline = pipeline
         self.ledger = ledger
         self.config = config
+        #: cost-accounting hook (accounting.CostAccounting, wired by
+        #: the app): compaction runs on a background thread with no
+        #: request context, so its cost is booked explicitly under the
+        #: ``system`` tenant — the amortised price of ingest-while-
+        #: serving shows up in /ops/costs next to the tenants it serves
+        self.accounting = None
         self._wake = threading.Event()
         self._stop = threading.Event()
         self._fold_lock = threading.Lock()
@@ -204,6 +210,19 @@ class DeltaCompactor:
             self._runs += 1
             self._folded_rows += folded_rows
             self._folded_shards += len(tail)
+        acct = self.accounting
+        if acct is not None:
+            try:
+                # one fold's work, booked to the system tenant: the
+                # merged rows were each read+written once (host_rows),
+                # and the delta shards folded are the tail retired
+                acct.record_system(
+                    "compaction",
+                    host_rows=folded_rows,
+                    delta_shards=len(tail),
+                )
+            except Exception:  # accounting must never fail a fold
+                log.exception("compaction cost accounting failed")
         publish_event(
             "compaction.complete",
             dataset=ds,

@@ -73,6 +73,7 @@ from ..telemetry import (
     TRACE_HEADER,
     RequestContext,
     annotate,
+    charge_cost,
     current_context,
     publish_event,
     request_context,
@@ -1258,7 +1259,10 @@ class MeshDispatchTier:
                     )
                 )
         # only the delta tail pays per-shard dispatch (host matching —
-        # deltas are small and carry no device index)
+        # deltas are small and carry no device index); that walk is
+        # cost-attributed to the request like the engine's own tail
+        if delta_targets:
+            charge_cost(delta_shards=len(delta_targets))
         for key, shard, native in delta_targets:
             responses.append(
                 materialize_response(
@@ -1836,10 +1840,12 @@ class DistributedEngine:
             else:
                 if status == 200:
                     # successful RTTs feed the router's p2c comparison
-                    # and the adaptive replica-hedge delay
-                    self.router.note_rtt(
-                        url, time.perf_counter() - t0
-                    )
+                    # and the adaptive replica-hedge delay — and the
+                    # request's cost vector: the worker was occupied
+                    # that long on this request's behalf (ISSUE 11)
+                    rtt_s = time.perf_counter() - t0
+                    self.router.note_rtt(url, rtt_s)
+                    charge_cost(worker_rtt_ms=rtt_s * 1e3)
                     self.breaker.record_success(url)
                     return [
                         VariantSearchResponse(**r)

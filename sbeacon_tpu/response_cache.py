@@ -48,7 +48,7 @@ import time
 from collections import OrderedDict, deque
 
 from .payloads import VariantQueryPayload, VariantSearchResponse
-from .telemetry import publish_event
+from .telemetry import charge_cost, publish_event
 
 
 def copy_response(r: VariantSearchResponse) -> VariantSearchResponse:
@@ -164,24 +164,36 @@ class ResponseCache:
             return self._gen
 
     def get(self, key: tuple) -> list[VariantSearchResponse] | None:
-        """Cached response set (fresh copies) or None."""
+        """Cached response set (fresh copies) or None. The outcome is
+        stamped onto the ambient request's cost vector — a tenant
+        whose traffic always hits costs near-nothing, and the
+        accounting plane can show exactly that."""
         now = time.monotonic()
         with self._lock:
             item = self._entries.get(key)
             if item is None:
                 self._misses += 1
-                return None
-            t_put, responses, _scope = item
-            if self.ttl_s > 0 and (now - t_put) > self.ttl_s:
-                del self._entries[key]
-                self._expirations += 1
-                self._misses += 1
-                return None
-            self._entries.move_to_end(key)
-            self._hits += 1
-            if not any(r.exists for r in responses):
-                self._negative_hits += 1
-            return [copy_response(r) for r in responses]
+                outcome = "miss"
+                hit = None
+            else:
+                t_put, responses, _scope = item
+                if self.ttl_s > 0 and (now - t_put) > self.ttl_s:
+                    del self._entries[key]
+                    self._expirations += 1
+                    self._misses += 1
+                    outcome = "miss"
+                    hit = None
+                else:
+                    self._entries.move_to_end(key)
+                    self._hits += 1
+                    if not any(r.exists for r in responses):
+                        self._negative_hits += 1
+                        outcome = "negative_hit"
+                    else:
+                        outcome = "hit"
+                    hit = [copy_response(r) for r in responses]
+        charge_cost(cache=outcome)
+        return hit
 
     def put(
         self,

@@ -50,6 +50,7 @@ from .resilience import (
 )
 from .telemetry import (
     annotate,
+    charge_cost_to,
     current_context,
     percentiles,
     profile_region,
@@ -80,6 +81,11 @@ class _Pending:
     #: request context at submit): when the backlog exceeds one batch,
     #: interactive entries ride the next launch ahead of bulk ones
     lane: str = "interactive"
+    #: the submitting request's context (cost attribution): the fetch
+    #: stage pro-rates the launch's measured device time to each
+    #: submission's share of the specs and charges it here — None
+    #: (warmup, bench direct) charges the unattributed residue
+    ctx: object = None
 
 
 class _Accumulator:
@@ -319,6 +325,7 @@ class MicroBatcher:
             deadline=deadline,
             req_deadline=req_deadline,
             lane=lane,
+            ctx=ctx,
         )
         with self._stats_lock:
             self._n_submits += 1
@@ -877,6 +884,13 @@ class MicroBatcher:
                     (t_launch - p.t_submit) * 1e3,
                     label_value="batch_wait",
                 )
+        for p in batch:
+            # batch wait is queued time on this request's clock — cost-
+            # attributed like the fair-queue wait (per-submission ctx:
+            # this runs on the launcher thread, not the request's)
+            charge_cost_to(
+                p.ctx, queue_wait_ms=(t_launch - p.t_submit) * 1e3
+            )
         try:
             with span("serving.microbatch") as sp, profile_region(
                 "sbeacon.kernel.launch"
@@ -957,6 +971,12 @@ class MicroBatcher:
                 stage_hist.observe(
                     (t_done - t_disp) * 1e3, label_value="fetch"
                 )
+            # device-launch cost attribution: the launch's measured
+            # execute time (launch -> results, the device's busy span
+            # for this program) pro-rated to each submission by its
+            # share of the flattened specs — the whole launch is always
+            # attributed, so sum(shares) == exec time exactly
+            n_specs = sum(len(p.specs) for p in batch) or 1
             for p, off in zip(batch, offsets):
                 sl = slice(off, off + len(p.specs))
                 p.result = QueryResults(
@@ -967,6 +987,10 @@ class MicroBatcher:
                     n_matched=res.n_matched[sl],
                     overflow=res.overflow[sl],
                     rows=res.rows[sl],
+                )
+                charge_cost_to(
+                    p.ctx,
+                    device_us=exec_ms * 1e3 * len(p.specs) / n_specs,
                 )
                 p.event.set()
         except BaseException as e:

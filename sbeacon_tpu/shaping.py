@@ -64,7 +64,7 @@ from contextlib import contextmanager
 
 from .harness.faults import fault_point
 from .resilience import DeadlineExceeded, Overloaded, current_deadline
-from .telemetry import publish_event
+from .telemetry import charge_cost, publish_event
 
 # -- lanes --------------------------------------------------------------------
 
@@ -72,6 +72,23 @@ LANE_INTERACTIVE = "interactive"
 LANE_BULK = "bulk"
 #: precedence order — earlier lanes drain first
 LANES = (LANE_INTERACTIVE, LANE_BULK)
+
+
+def requested_granularity(
+    query_params: dict | None, body: dict | None
+) -> str | None:
+    """The request's requestedGranularity (body wins over query
+    params), lowercased, or None — ONE extraction shared by the lane
+    classifier and the cost-accounting shape key, so the two can
+    never diverge on precedence."""
+    g = None
+    if isinstance(body, dict):
+        q = body.get("query")
+        if isinstance(q, dict):
+            g = q.get("requestedGranularity")
+    if g is None and query_params:
+        g = query_params.get("requestedGranularity")
+    return str(g).lower() if g else None
 
 
 def classify_lane(
@@ -84,14 +101,8 @@ def classify_lane(
     entity lookups and framework endpoints are small."""
     if path_head == "submit":
         return LANE_BULK
-    g = None
-    if isinstance(body, dict):
-        q = body.get("query")
-        if isinstance(q, dict):
-            g = q.get("requestedGranularity")
-    if g is None and query_params:
-        g = query_params.get("requestedGranularity")
-    return LANE_BULK if str(g).lower() == "record" else LANE_INTERACTIVE
+    g = requested_granularity(query_params, body)
+    return LANE_BULK if g == "record" else LANE_INTERACTIVE
 
 
 # -- tenant classification ----------------------------------------------------
@@ -151,12 +162,19 @@ def parse_tenant_weights(spec: str) -> dict[str, float]:
 
 
 class _Waiter:
-    __slots__ = ("event", "tenant", "lane", "t_enqueue", "granted", "rejected")
+    __slots__ = (
+        "event", "tenant", "lane", "shape", "t_enqueue",
+        "granted", "rejected",
+    )
 
-    def __init__(self, tenant: str, lane: str, now: float):
+    def __init__(self, tenant: str, lane: str, now: float,
+                 shape: str | None = None):
         self.event = threading.Event()
         self.tenant = tenant
         self.lane = lane
+        #: the query-shape key (accounting.query_shape) for the
+        #: cost-aware DRR charge; None = flat 1-per-request deficit
+        self.shape = shape
         self.t_enqueue = now
         self.granted = False
         self.rejected = False
@@ -212,6 +230,11 @@ class FairQueueAdmission:
     #: min seconds between shaping.shed flight-recorder events — a shed
     #: flood is ONE incident, not thousands of journal entries
     SHED_EVENT_INTERVAL_S = 1.0
+    #: clamp on the cost-aware DRR charge: the refill-on-visit cap
+    #: banks at most ``2 * max(weight, 1)`` of deficit, so a charge
+    #: above 2.0 could strand a queued waiter at quiescence
+    MIN_DRR_CHARGE = 0.25
+    MAX_DRR_CHARGE = 2.0
 
     def __init__(
         self,
@@ -226,6 +249,7 @@ class FairQueueAdmission:
         retry_floor_s: float = 1.0,
         retry_ceil_s: float = 60.0,
         max_tenants: int = 64,
+        cost_charge_fn=None,
         clock=time.monotonic,
     ):
         if max_in_flight < 1 or tenant_max_in_flight < 1:
@@ -240,6 +264,11 @@ class FairQueueAdmission:
         self.retry_floor_s = retry_floor_s
         self.retry_ceil_s = retry_ceil_s
         self.max_tenants = max(1, max_tenants)
+        #: cost-aware DRR hook (``accounting.drr_charge``): maps
+        #: (lane, shape) to the deficit a grant costs. None (default)
+        #: keeps the flat 1-per-request charge — the pre-cost path,
+        #: byte-identical (``BEACON_COST_DRR`` wires it).
+        self._cost_charge_fn = cost_charge_fn
         self._clock = clock
         self._lock = threading.Lock()
         self._tenants: dict[str, _TenantState] = {}
@@ -293,13 +322,17 @@ class FairQueueAdmission:
 
     # -- admission -----------------------------------------------------------
 
-    def acquire(self, tenant: str, lane: str) -> str:
+    def acquire(
+        self, tenant: str, lane: str, shape: str | None = None
+    ) -> str:
         """Block until admitted; returns the RESOLVED tenant key (the
         overflow bucket may differ from the requested id) which the
         caller must pass back to :meth:`release`. Raises ``Overloaded``
         on shed (queue full, brownout, queue-wait bound) and
         ``DeadlineExceeded`` when the request's deadline lapsed while
-        queued."""
+        queued. ``shape`` is the query-shape key the cost-aware DRR
+        charge looks up; it has no effect without a
+        ``cost_charge_fn``."""
         # chaos site: plans can delay or fail the fair-queue path like
         # worker.http / kernel.launch / sqlite.commit (sleeps happen
         # here, OUTSIDE the shaper lock)
@@ -324,7 +357,7 @@ class FairQueueAdmission:
                     ts, lane, f"tenant {ts.name!r} {lane} queue full"
                 )
             else:
-                w = _Waiter(ts.name, lane, self._clock())
+                w = _Waiter(ts.name, lane, self._clock(), shape=shape)
                 ts.queues[lane].append(w)
                 self._queued += 1
         if shed_exc is not None:
@@ -373,8 +406,8 @@ class FairQueueAdmission:
             g.event.set()
 
     @contextmanager
-    def admit(self, tenant: str, lane: str):
-        key = self.acquire(tenant, lane)
+    def admit(self, tenant: str, lane: str, shape: str | None = None):
+        key = self.acquire(tenant, lane, shape)
         try:
             yield
         finally:
@@ -453,12 +486,30 @@ class FairQueueAdmission:
             w = self._pop_lane_locked(LANE_BULK)
         return w
 
+    def _grant_charge_locked(self, lane: str, w: _Waiter) -> float:
+        """The deficit granting ``w`` costs: flat 1.0 without a cost
+        hook; with one (``BEACON_COST_DRR``), the measured mean cost
+        of the waiter's query shape relative to the lane mean, clamped
+        to [MIN_DRR_CHARGE, MAX_DRR_CHARGE] so no shape can be starved
+        outright or ride entirely free — a record retrieval that costs
+        4x a boolean probe drains a tenant's fair share roughly 2x as
+        fast (the clamp), instead of counting the same."""
+        fn = self._cost_charge_fn
+        if fn is None or w.shape is None:
+            return 1.0
+        try:
+            c = float(fn(lane, w.shape))
+        except Exception:  # a cost hook must never fail admission
+            return 1.0
+        return min(self.MAX_DRR_CHARGE, max(self.MIN_DRR_CHARGE, c))
+
     def _pop_lane_locked(self, lane: str) -> _Waiter | None:
         """One waiter from ``lane`` by weighted DRR: each rotation
         visit refills a tenant's deficit by its weight; each grant
-        costs 1 — so over a backlog, grants converge to the weight
-        ratio. Tenants at their in-flight cap are skipped (their
-        deficit keeps, fairness resumes when slots free)."""
+        costs its shape's charge (flat 1 without the cost hook) — so
+        over a backlog, granted WORK converges to the weight ratio.
+        Tenants at their in-flight cap are skipped (their deficit
+        keeps, fairness resumes when slots free)."""
         active = [
             ts
             for ts in self._tenants.values()
@@ -468,23 +519,41 @@ class FairQueueAdmission:
             return None
         n = len(active)
         ptr = self._rr[lane]
-        # enough rotations that even the smallest active weight banks a
-        # full unit of deficit: a fixed 2n+1 strands any weight < 0.5
-        # (the pop returns None, the dispatch pass ends, and at
-        # quiescence nothing re-triggers it — the waiter sheds on its
-        # queue-wait bound against a free server)
+        # enough rotations that even the smallest active weight banks
+        # the LARGEST possible charge of deficit: a fixed 2n+1 strands
+        # any weight < 0.5 (the pop returns None, the dispatch pass
+        # ends, and at quiescence nothing re-triggers it — the waiter
+        # sheds on its queue-wait bound against a free server). With
+        # the cost hook armed, a head may cost up to MAX_DRR_CHARGE.
         wmin = min(ts.weight for ts in active)
-        rounds = n * (int(math.ceil(1.0 / wmin)) + 1) + 1
+        max_charge = (
+            1.0 if self._cost_charge_fn is None else self.MAX_DRR_CHARGE
+        )
+        rounds = n * (int(math.ceil(max_charge / wmin)) + 1) + 1
+        # each head's charge is computed ONCE per pop: the rotation may
+        # visit a tenant dozens of times before its deficit suffices,
+        # and the cost hook takes the accounting plane's lock — no
+        # reason to pay that round-trip per visit for a value that
+        # cannot change within one pop (heads only move on a grant)
+        charge_cache: dict[int, float] = {}
         for _ in range(rounds):
             ts = active[ptr % n]
-            if ts.deficit[lane] >= 1.0:
-                ts.deficit[lane] -= 1.0
-                self._rr[lane] = ptr
-                return ts.queues[lane].popleft()
+            if ts.queues[lane]:
+                need = charge_cache.get(id(ts))
+                if need is None:
+                    need = charge_cache[id(ts)] = (
+                        self._grant_charge_locked(lane, ts.queues[lane][0])
+                    )
+                if ts.deficit[lane] >= need:
+                    ts.deficit[lane] -= need
+                    self._rr[lane] = ptr
+                    return ts.queues[lane].popleft()
             ptr += 1
             nxt = active[ptr % n]
             # refill on advancing INTO a tenant, capped so an idle
-            # spell cannot bank unbounded burst credit
+            # spell cannot bank unbounded burst credit (the cap is why
+            # MAX_DRR_CHARGE must stay <= 2: a costlier head could
+            # never accumulate enough deficit to be granted)
             nxt.deficit[lane] = min(
                 nxt.deficit[lane] + nxt.weight, 2 * max(nxt.weight, 1.0)
             )
@@ -784,9 +853,14 @@ class TrafficShaper:
         self.ladder = ladder
 
     @classmethod
-    def from_config(cls, config, *, hedge_control=None) -> "TrafficShaper":
+    def from_config(
+        cls, config, *, hedge_control=None, cost_charge_fn=None
+    ) -> "TrafficShaper":
         """Build from a BeaconConfig (``config.shaping`` +
-        ``config.resilience.max_in_flight`` as the global running cap)."""
+        ``config.resilience.max_in_flight`` as the global running cap).
+        ``cost_charge_fn`` (``accounting.drr_charge``) is only wired
+        through when ``shaping.cost_drr`` is on, so the default DRR
+        charge path stays byte-identical to the flat one."""
         sh = config.shaping
         queue = FairQueueAdmission(
             max_in_flight=config.resilience.max_in_flight,
@@ -799,6 +873,11 @@ class TrafficShaper:
             retry_floor_s=sh.retry_after_floor_s,
             retry_ceil_s=sh.retry_after_ceil_s,
             max_tenants=sh.max_tenants,
+            cost_charge_fn=(
+                cost_charge_fn
+                if getattr(sh, "cost_drr", False)
+                else None
+            ),
         )
         ladder = None
         if sh.brownout:
@@ -827,12 +906,20 @@ class TrafficShaper:
         return classify_lane(path_head, query_params, body)
 
     @contextmanager
-    def admit(self, tenant: str, lane: str):
+    def admit(self, tenant: str, lane: str, shape: str | None = None):
         if not self.enabled:
             yield
             return
-        with self.queue.admit(tenant, lane):
+        t0 = time.monotonic()
+        key = self.queue.acquire(tenant, lane, shape)
+        # the fair-queue wait is attributed to the request's cost
+        # vector (queue_wait_ms: contention a tenant causes/suffers,
+        # reported per tenant but excluded from the cost-unit scalar)
+        charge_cost(queue_wait_ms=(time.monotonic() - t0) * 1e3)
+        try:
             yield
+        finally:
+            self.queue.release(key)
 
     def on_slo_signal(self, breached_routes) -> None:
         if self.enabled and self.ladder is not None:

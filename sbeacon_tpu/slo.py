@@ -173,6 +173,16 @@ class SloEngine:
     #: is O(routes x windows) to compute and must not run per request
     NOTIFY_INTERVAL_S = 1.0
 
+    #: distinct tenants carrying their own burn rings before new ids
+    #: share the overflow bucket (shaping's 64-tenant cap, reused)
+    MAX_TENANTS = 64
+    #: the shared bucket once MAX_TENANTS tenants are tracked
+    OVERFLOW_TENANT = "overflow"
+    #: tenant-scoped rings use coarser buckets than the global ones:
+    #: 64 tenants x routes x 5s buckets would be real memory for a
+    #: per-tenant VIEW, and 30s resolution is plenty for attribution
+    TENANT_BUCKET_S = 30.0
+
     def __init__(
         self,
         *,
@@ -181,6 +191,7 @@ class SloEngine:
         windows: tuple = WINDOWS,
         alert_burn_rate: float = 14.4,
         bucket_s: float = 5.0,
+        max_tenants: int | None = None,
         clock=time.monotonic,
     ):
         self.default = default or SloObjective()
@@ -192,6 +203,14 @@ class SloEngine:
         self._clock = clock
         self._lock = threading.Lock()
         self._route_states: dict[str, _RouteState] = {}
+        # tenant -> route -> _RouteState: the per-tenant SLO view
+        # (/slo?tenant=...), recorded alongside the global rings so a
+        # tenant's 5xx storm is attributable without moving any other
+        # tenant's burn. Cardinality-bounded like shaping's classifier.
+        self.max_tenants = int(
+            max_tenants if max_tenants is not None else self.MAX_TENANTS
+        )
+        self._tenant_states: dict[str, dict[str, _RouteState]] = {}
         self._listeners: list = []
         self._last_notify = -math.inf
         # routes with declared overrides exist from the start, so /slo
@@ -202,9 +221,13 @@ class SloEngine:
             )
 
     @classmethod
-    def from_config(cls, obs) -> "SloEngine":
+    def from_config(
+        cls, obs, *, max_tenants: int | None = None
+    ) -> "SloEngine":
         """Build from an ObservabilityConfig (the ``BEACON_SLO_*``
-        tier)."""
+        tier). ``max_tenants`` threads shaping's tenant cap through so
+        every tenant-bounded plane (shaping, accounting, SLO views)
+        collapses to overflow at the SAME count."""
         default = SloObjective(
             availability_target=getattr(
                 obs, "slo_availability_target", 0.999
@@ -218,6 +241,7 @@ class SloEngine:
                 getattr(obs, "slo_routes", "") or "", default
             ),
             alert_burn_rate=getattr(obs, "slo_alert_burn_rate", 14.4),
+            max_tenants=max_tenants,
         )
 
     @staticmethod
@@ -229,13 +253,24 @@ class SloEngine:
 
     # -- the request-path entry ---------------------------------------------
 
-    def record(self, route: str, status: int, elapsed_ms: float) -> None:
+    def record(
+        self,
+        route: str,
+        status: int,
+        elapsed_ms: float,
+        tenant: str | None = None,
+    ) -> None:
         """One request outcome. Availability: 5xx is bad. Latency: only
         non-5xx requests count (a failed request's latency is noise),
         bad when over the route's threshold. Route cardinality is
-        bounded upstream by the API layer's route labeling."""
+        bounded upstream by the API layer's route labeling; ``tenant``
+        (when classified) additionally lands the outcome in that
+        tenant's own rings — isolated, so one tenant's storm never
+        moves another's view — bounded by ``max_tenants`` with
+        overflow sharing one bucket."""
         if self.tracked(route):
             ok = status < 500
+            good_latency = elapsed_ms  # compared per-objective below
             with self._lock:
                 st = self._route_states.get(route)
                 if st is None:
@@ -247,7 +282,33 @@ class SloEngine:
                     )
                 st.avail.record(ok)
                 if ok:
-                    st.latency.record(elapsed_ms <= st.objective.latency_ms)
+                    st.latency.record(
+                        good_latency <= st.objective.latency_ms
+                    )
+                if tenant:
+                    by_route = self._tenant_states.get(tenant)
+                    if by_route is None:
+                        if (
+                            len(self._tenant_states) >= self.max_tenants
+                            and tenant != self.OVERFLOW_TENANT
+                        ):
+                            tenant = self.OVERFLOW_TENANT
+                            by_route = self._tenant_states.get(tenant)
+                        if by_route is None:
+                            by_route = self._tenant_states[tenant] = {}
+                    tst = by_route.get(route)
+                    if tst is None:
+                        tst = by_route[route] = _RouteState(
+                            self.overrides.get(route, self.default),
+                            self._horizon_s,
+                            self.TENANT_BUCKET_S,
+                            self._clock,
+                        )
+                    tst.avail.record(ok)
+                    if ok:
+                        tst.latency.record(
+                            good_latency <= tst.objective.latency_ms
+                        )
         # untracked routes still drive notification: health probes must
         # keep the brownout ladder's recovery clock ticking even when
         # shed 429s are the only tracked traffic
@@ -325,22 +386,43 @@ class SloEngine:
         doc["breached"] = breached_any
         return doc
 
-    def snapshot(self) -> dict:
+    def snapshot(self, tenant: str | None = None) -> dict:
         """The ``/slo`` document: every tracked route's objectives,
-        per-window good/bad/burn, and breach verdicts."""
+        per-window good/bad/burn, and breach verdicts. With ``tenant``
+        (the ``/slo?tenant=...`` view) the SAME document shape is
+        rendered from that tenant's isolated rings — routes the tenant
+        never touched are absent, and a ``tenant`` field names the
+        scope (the overflow bucket, when the id overflowed the cap)."""
         # evaluated under the engine lock: _BucketRing's lazy-reset
         # slots are only coherent when reads exclude record()'s
         # stamp-then-zero mutation (a horizon-old bucket's counts must
         # never surface under a fresh epoch)
         with self._lock:
-            return {
+            if tenant is None:
+                states = self._route_states
+            else:
+                if (
+                    tenant not in self._tenant_states
+                    and len(self._tenant_states) >= self.max_tenants
+                ):
+                    tenant = self.OVERFLOW_TENANT
+                states = self._tenant_states.get(tenant, {})
+            doc = {
                 "alertBurnRate": self.alert_burn_rate,
                 "windows": {n: s for n, s in self.windows},
                 "routes": {
                     route: self._route_doc(route, st)
-                    for route, st in sorted(self._route_states.items())
+                    for route, st in sorted(states.items())
                 },
             }
+            if tenant is not None:
+                doc["tenant"] = tenant
+            return doc
+
+    def tenants(self) -> list[str]:
+        """Tenants with per-tenant burn rings (``/slo`` discovery)."""
+        with self._lock:
+            return sorted(self._tenant_states)
 
     def burn_rates(self, kind: str = "availability") -> dict:
         """{(route, window): burn rate} for the gauge callbacks."""

@@ -75,6 +75,16 @@ LATENCY_BUCKETS_MS = (
 _NAME_RE = re.compile(r"^[a-z0-9_]+(\.[a-z0-9_]+)+$")
 
 
+#: default cap on distinct label values a value-owning instrument may
+#: mint per family; overflow collapses to :data:`OVERFLOW_LABEL` and
+#: ticks the registry's ``telemetry.label_overflow`` counter — the
+#: registry-level twin of shaping's 64-tenant cap, so NO producer can
+#: turn attacker-controlled input into unbounded series
+DEFAULT_MAX_LABEL_VALUES = 64
+#: the shared bucket overflowing label values collapse into
+OVERFLOW_LABEL = "other"
+
+
 class _Instrument:
     """Shared base: a named, optionally labeled, typed series.
 
@@ -87,12 +97,22 @@ class _Instrument:
     "window")``): the value dict is then keyed by matching tuples of
     label values, rendered as multi-label Prometheus series and as
     nested maps in the JSON snapshot.
+
+    Value-owning labeled instruments enforce a **cardinality guard**:
+    at most ``max_label_values`` distinct label values are ever minted
+    per family; further values collapse into the shared ``"other"``
+    bucket and tick ``telemetry.label_overflow{family=...}``. (Before
+    this guard only shaping's tenant classifier enforced a cap — the
+    registry itself would happily mint a series per attacker-chosen
+    header value.) Callback-backed instruments are exempt: their
+    producer owns the state and its bounds.
     """
 
     kind = "untyped"
 
     def __init__(self, name: str, help: str = "", *,
-                 fn=None, label=None, json_render: bool = True):
+                 fn=None, label=None, json_render: bool = True,
+                 max_label_values: int | None = None):
         if not _NAME_RE.match(name):
             raise ValueError(
                 f"metric name {name!r} must be dotted lowercase "
@@ -111,15 +131,43 @@ class _Instrument:
         #: False = Prometheus-only (used where the back-compat JSON
         #: shape differs from the dotted nesting, e.g. breaker state)
         self.json_render = json_render
+        self.max_label_values = int(
+            max_label_values
+            if max_label_values is not None
+            else DEFAULT_MAX_LABEL_VALUES
+        )
+        #: the registry's shared label-overflow counter (set at
+        #: registration; None on free-standing instruments)
+        self._overflow = None
         self._lock = threading.Lock()
         self._value = 0.0
         self._children: dict[str, float] = {}
+
+    def _guard_label(self, label_value, children: dict):
+        """The label value to actually mint, under the cardinality
+        guard (call holding ``self._lock``): a NEW value on a family
+        already at its cap collapses to ``"other"``."""
+        if (
+            label_value is None
+            or label_value in children
+            or len(children) < self.max_label_values
+        ):
+            return label_value
+        ov = self._overflow
+        if ov is not None and ov is not self:
+            ov.inc(label_value=self.name)
+        if isinstance(label_value, tuple):
+            return (OVERFLOW_LABEL,) * len(label_value)
+        return OVERFLOW_LABEL
 
     def _bump(self, n: float, label_value: str | None) -> None:
         with self._lock:
             if label_value is None:
                 self._value += n
             else:
+                label_value = self._guard_label(
+                    label_value, self._children
+                )
                 self._children[label_value] = (
                     self._children.get(label_value, 0.0) + n
                 )
@@ -157,6 +205,9 @@ class Gauge(_Instrument):
             if label_value is None:
                 self._value = float(v)
             else:
+                label_value = self._guard_label(
+                    label_value, self._children
+                )
                 self._children[label_value] = float(v)
 
 
@@ -183,8 +234,10 @@ class Histogram(_Instrument):
     def __init__(self, name: str, help: str = "", *,
                  buckets: tuple = LATENCY_BUCKETS_MS,
                  label: str | None = None,
-                 exemplars: bool = False):
-        super().__init__(name, help, label=label)
+                 exemplars: bool = False,
+                 max_label_values: int | None = None):
+        super().__init__(name, help, label=label,
+                         max_label_values=max_label_values)
         self.buckets = tuple(float(b) for b in buckets)
         self.exemplars_enabled = bool(exemplars)
         # label_value (or "") -> [counts per bucket + overflow, count, sum]
@@ -200,6 +253,8 @@ class Histogram(_Instrument):
             if ctx is not None:
                 exemplar = ctx.trace_id
         with self._lock:
+            if key:
+                key = self._guard_label(key, self._series)
             s = self._series.get(key)
             if s is None:
                 s = self._series[key] = [
@@ -258,34 +313,54 @@ class MetricsRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._instruments: dict[str, _Instrument] = {}
+        # the registry's own cardinality-guard evidence: one family
+        # label per instrument that ever collapsed a label value to
+        # "other" (family names are bounded by the registrations)
+        registry = self
+        self._label_overflow = registry.counter(
+            "telemetry.label_overflow",
+            "label values collapsed to 'other' by the cardinality guard",
+            label="family",
+        )
 
     def _register(self, inst: _Instrument) -> _Instrument:
         with self._lock:
             if inst.name in self._instruments:
                 raise ValueError(f"metric {inst.name!r} already registered")
             self._instruments[inst.name] = inst
+            # wire the shared overflow counter into every value-owning
+            # instrument (the counter itself guards via its own cap)
+            inst._overflow = getattr(self, "_label_overflow", None)
         return inst
 
     def counter(self, name: str, help: str = "", *,
                 fn=None, label=None,
-                json_render: bool = True) -> Counter:
+                json_render: bool = True,
+                max_label_values: int | None = None) -> Counter:
         return self._register(
-            Counter(name, help, fn=fn, label=label, json_render=json_render)
+            Counter(name, help, fn=fn, label=label,
+                    json_render=json_render,
+                    max_label_values=max_label_values)
         )
 
     def gauge(self, name: str, help: str = "", *,
               fn=None, label=None,
-              json_render: bool = True) -> Gauge:
+              json_render: bool = True,
+              max_label_values: int | None = None) -> Gauge:
         return self._register(
-            Gauge(name, help, fn=fn, label=label, json_render=json_render)
+            Gauge(name, help, fn=fn, label=label,
+                  json_render=json_render,
+                  max_label_values=max_label_values)
         )
 
     def histogram(self, name: str, help: str = "", *,
                   buckets: tuple = LATENCY_BUCKETS_MS,
                   label: str | None = None,
-                  exemplars: bool = False) -> Histogram:
+                  exemplars: bool = False,
+                  max_label_values: int | None = None) -> Histogram:
         return self._register(Histogram(name, help, buckets=buckets,
-                                        label=label, exemplars=exemplars))
+                                        label=label, exemplars=exemplars,
+                                        max_label_values=max_label_values))
 
     def names(self) -> list[str]:
         with self._lock:
@@ -428,6 +503,133 @@ def _num(v) -> str:
     return f"{f:g}"
 
 
+# -- per-request cost vector ---------------------------------------------------
+
+
+class CostVector:
+    """The resource cost ONE request incurred, accumulated additively
+    by the instrumentation points along its path (ISSUE 11):
+
+    - ``device_us`` — device-launch microseconds, pro-rated from the
+      batcher's measured per-launch execute time to this request's
+      share of the launch's query specs (serving.py);
+    - ``host_rows`` — candidate rows walked by the numpy host matcher
+      (``engine.host_match_rows`` — per-shard fallbacks, overflow
+      paths, and the delta tail);
+    - ``delta_shards`` — delta-tail shards walked for this query
+      (engine / mesh-tier per-shard host dispatch);
+    - ``worker_rtt_ms`` — coordinator->worker round-trip time on
+      successful ``/search`` legs (a worker was occupied that long on
+      this request's behalf);
+    - ``queue_wait_ms`` — time queued (fair-queue admission wait +
+      micro-batch wait); contention, not resource cost, so it is
+      excluded from the cost-unit scalar but attributed per tenant;
+    - ``response_bytes`` — serialized response size;
+    - ``cache`` — response-cache outcome (``hit`` / ``negative_hit`` /
+      ``miss`` / ``""`` when the cache never saw the query).
+
+    One vector rides each :class:`RequestContext`; charges without an
+    ambient context fall into the process-global
+    :data:`UNATTRIBUTED_COST` residue so the accounting plane can
+    prove what fraction of measured work it attributed. Additive
+    updates take one short lock — engine scatter threads and the
+    batcher's fetcher thread charge the same vector concurrently.
+    """
+
+    NUMERIC = (
+        "device_us",
+        "host_rows",
+        "delta_shards",
+        "worker_rtt_ms",
+        "queue_wait_ms",
+        "response_bytes",
+    )
+
+    __slots__ = NUMERIC + ("cache", "_sealed", "_lock")
+
+    def __init__(self):
+        for f in self.NUMERIC:
+            setattr(self, f, 0.0)
+        self.cache = ""
+        self._sealed = False
+        self._lock = threading.Lock()
+
+    def add(self, *, cache: str | None = None, **fields) -> None:
+        """Accumulate numeric fields (and/or set the cache outcome).
+        Unknown field names raise — a typo'd charge site must fail in
+        tests, not silently leak cost. Charges landing AFTER the
+        vector was :meth:`seal`-ed (the request already folded into
+        the accounting table — e.g. a launch completing after its
+        submitter 504ed, or a losing hedge leg's RTT) redirect to the
+        unattributed residue, so they appear in the attribution
+        DENOMINATOR instead of vanishing from both sides."""
+        with self._lock:
+            sealed = self._sealed
+            if not sealed:
+                for k, v in fields.items():
+                    if k not in self.NUMERIC:
+                        raise ValueError(f"unknown cost field {k!r}")
+                    setattr(self, k, getattr(self, k) + float(v))
+                if cache:
+                    self.cache = cache
+        if sealed and self is not UNATTRIBUTED_COST:
+            UNATTRIBUTED_COST.add(cache=cache, **fields)
+
+    def seal(self) -> None:
+        """Mark the vector folded: later charges go to the residue."""
+        with self._lock:
+            self._sealed = True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = {f: getattr(self, f) for f in self.NUMERIC}
+            out["cache"] = self.cache
+        return out
+
+    def nonzero(self) -> bool:
+        with self._lock:
+            return bool(self.cache) or any(
+                getattr(self, f) for f in self.NUMERIC
+            )
+
+    def as_dict(self) -> dict:
+        """Compact rounded rendering for slow-query-log records and
+        ``/debug/status`` — zero fields are dropped."""
+        snap = self.snapshot()
+        out = {}
+        for f in self.NUMERIC:
+            v = snap[f]
+            if v:
+                out[f] = round(v, 2)
+        if snap["cache"]:
+            out["cache"] = snap["cache"]
+        return out
+
+
+#: process-global residue: charges that land with NO ambient request
+#: context (warmup launches, background drains, abandoned waiters)
+#: accumulate here, so ``/ops/costs`` can report an attribution ratio
+#: instead of silently dropping unowned work
+UNATTRIBUTED_COST = CostVector()
+
+
+def charge_cost(**fields) -> None:
+    """Charge the current request's cost vector (ambient context), or
+    the process-global unattributed residue when off-request. The
+    no-context fast path is one thread-local read."""
+    ctx = getattr(_ambient, "ctx", None)
+    vec = ctx.cost if ctx is not None else UNATTRIBUTED_COST
+    vec.add(**fields)
+
+
+def charge_cost_to(ctx, **fields) -> None:
+    """Charge an EXPLICIT request context's cost vector (pool threads
+    holding a captured context, e.g. the batcher's fetcher stage);
+    ``ctx=None`` charges the unattributed residue."""
+    vec = ctx.cost if ctx is not None else UNATTRIBUTED_COST
+    vec.add(**fields)
+
+
 # -- request context / distributed tracing ------------------------------------
 
 #: the cross-process trace header (coordinator->worker and client->API)
@@ -466,13 +668,16 @@ class RequestContext:
     still be annotating after the request returned. Two concurrent
     annotates may drop one note; acceptable for observability."""
 
-    __slots__ = ("trace_id", "route", "t_start", "notes")
+    __slots__ = ("trace_id", "route", "t_start", "notes", "cost")
 
     def __init__(self, trace_id: str | None = None, route: str = ""):
         self.trace_id = trace_id or new_trace_id()
         self.route = route
         self.t_start = time.perf_counter()
         self.notes: dict = {}
+        #: the request's resource-cost vector (ISSUE 11): created
+        #: eagerly so concurrent charge sites never race an install
+        self.cost = CostVector()
 
     def elapsed_ms(self) -> float:
         return (time.perf_counter() - self.t_start) * 1e3
@@ -498,6 +703,34 @@ def request_context(ctx: RequestContext | None):
         yield ctx
     finally:
         _ambient.ctx = prev
+
+
+#: the literal registry of every outcome-note key producers may
+#: ``annotate(...)`` — the slow-query log's schema, in effect. The
+#: static lint ``tools/check_annotation_keys.py`` (tier-1 via
+#: tests/test_telemetry.py) enforces two-way parity between this set
+#: and the annotate() call sites, exactly like the metric-name lint:
+#: an unregistered key is an invisible note, a registered-but-unused
+#: key is a dashboard field that silently flatlined.
+ANNOTATION_KEYS = frozenset({
+    "batch_index",
+    "batch_ms",
+    "breaker",
+    "dispatch",
+    "dispatch_tier",
+    "failover",
+    "granularity",
+    "lane",
+    "mesh_delta_tail",
+    "mesh_fallback",
+    "mesh_shards",
+    "query_job",
+    "replica_hedge",
+    "response_cache",
+    "short_circuit",
+    "tenant",
+    "unavailable_datasets",
+})
 
 
 def annotate(**kw) -> None:
@@ -644,18 +877,25 @@ class EventJournal:
     def events(self, *, since: int = 0, kind: str = "",
                limit: int = 256) -> list[dict]:
         """Events with seq > ``since``, newest last, optionally
-        filtered by kind (exact, or prefix: ``kind=breaker`` matches
-        ``breaker.open``), capped at the most recent ``limit``."""
+        filtered by kind, capped at the most recent ``limit``.
+
+        ``kind`` is a COMMA-SEPARATED list of filters, each matching
+        exactly or by prefix (``breaker`` matches ``breaker.open``) —
+        so an operator correlating two control planes
+        (``?kind=compaction,shaping.brownout``) tails ONE interleaved
+        stream instead of merging two polls by hand."""
+        kinds = [k.strip() for k in kind.split(",") if k.strip()]
+
+        def _match(k: str) -> bool:
+            return not kinds or any(
+                k == want or k.startswith(want + ".") for want in kinds
+            )
+
         with self._lock:
             evs = [
                 dict(e)
                 for e in self._ring
-                if e["seq"] > since
-                and (
-                    not kind
-                    or e["kind"] == kind
-                    or e["kind"].startswith(kind + ".")
-                )
+                if e["seq"] > since and _match(e["kind"])
             ]
         limit = int(limit)
         return evs[-limit:] if limit > 0 else []

@@ -1,0 +1,482 @@
+"""Per-request cost attribution + the tenant accounting plane
+(ISSUE 11): CostVector charging, the (tenant, lane, shape) table with
+bounded cardinality and decaying windows, the /ops/costs rollup, cost
+fields on slow-query records, and the cost-aware DRR scheduling seam
+(measured shape cost charged against the fair-queue deficit)."""
+
+import random
+import threading
+import time
+
+import pytest
+
+from sbeacon_tpu.accounting import (
+    SYSTEM_TENANT,
+    CostAccounting,
+    cost_units,
+    query_shape,
+)
+from sbeacon_tpu.shaping import FairQueueAdmission
+from sbeacon_tpu.telemetry import (
+    UNATTRIBUTED_COST,
+    CostVector,
+    MetricsRegistry,
+    RequestContext,
+    charge_cost,
+    charge_cost_to,
+    request_context,
+)
+
+obs = pytest.mark.obs
+
+
+# -- CostVector ----------------------------------------------------------------
+
+
+@obs
+def test_cost_vector_accumulates_and_snapshots():
+    v = CostVector()
+    assert not v.nonzero()
+    v.add(device_us=100.0, host_rows=50)
+    v.add(device_us=25.0, cache="hit")
+    snap = v.snapshot()
+    assert snap["device_us"] == 125.0
+    assert snap["host_rows"] == 50
+    assert snap["cache"] == "hit"
+    assert v.nonzero()
+    d = v.as_dict()
+    assert d == {"device_us": 125.0, "host_rows": 50, "cache": "hit"}
+    with pytest.raises(ValueError):
+        v.add(bogus_field=1.0)  # a typo'd charge site must fail loud
+
+
+@obs
+def test_cost_vector_concurrent_adds_do_not_drop():
+    v = CostVector()
+
+    def worker():
+        for _ in range(1000):
+            v.add(host_rows=1)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert v.snapshot()["host_rows"] == 4000
+
+
+@obs
+def test_charge_cost_ambient_vs_unattributed():
+    ctx = RequestContext()
+    before = UNATTRIBUTED_COST.snapshot()["host_rows"]
+    with request_context(ctx):
+        charge_cost(host_rows=7)
+    assert ctx.cost.snapshot()["host_rows"] == 7
+    # off-request charges land in the process-global residue, so the
+    # attribution ratio is measurable instead of assumed
+    charge_cost(host_rows=3)
+    assert UNATTRIBUTED_COST.snapshot()["host_rows"] == before + 3
+    # explicit-context charging (fetcher-thread style)
+    charge_cost_to(ctx, device_us=5.0)
+    assert ctx.cost.snapshot()["device_us"] == 5.0
+
+
+@obs
+def test_cost_units_math_and_query_shape():
+    assert cost_units({"device_us": 100.0}) == 100.0
+    assert cost_units({"host_rows": 100}) == pytest.approx(2.0)
+    assert cost_units({"worker_rtt_ms": 2.0}) == pytest.approx(2000.0)
+    # queue wait is contention, not work: excluded from the scalar
+    assert cost_units({"queue_wait_ms": 1e9}) == 0.0
+    assert query_shape("g_variants", "record") == "g_variants:record"
+    assert query_shape("g_variants", None) == "g_variants:default"
+    assert query_shape("info", "BOGUS!") == "info:other"
+
+
+# -- the accounting table ------------------------------------------------------
+
+
+@obs
+def test_table_folds_by_tenant_lane_shape():
+    acct = CostAccounting()
+    acct.record("gold", "interactive", "g_variants:boolean",
+                {"device_us": 100.0, "host_rows": 10})
+    acct.record("gold", "interactive", "g_variants:boolean",
+                {"device_us": 300.0})
+    acct.record("free", "bulk", "g_variants:record",
+                {"device_us": 50.0, "response_bytes": 1000})
+    snap = acct.snapshot()
+    assert snap["enabled"] is True
+    assert snap["totals"]["requests"] == 3
+    assert snap["tenants"]["gold"]["requests"] == 2
+    assert snap["tenants"]["gold"]["device_us"] == 400.0
+    assert snap["tenants"]["free"]["response_bytes"] == 1000
+    assert snap["costliestTenant"] == "gold"
+    assert snap["costliestShape"] == "g_variants:boolean"
+    shapes = snap["shapes"]
+    assert shapes["g_variants:boolean"]["lane"] == "interactive"
+    assert shapes["g_variants:boolean"]["requests"] == 2
+    assert shapes["g_variants:boolean"]["meanUnits"] == pytest.approx(
+        (100 + 10 * 0.02 + 300) / 2, rel=1e-3
+    )
+    assert "p99Units" in shapes["g_variants:boolean"]
+    assert snap["topTenants"][0][0] == "gold"
+
+
+@obs
+def test_tenant_and_shape_cardinality_caps():
+    acct = CostAccounting(max_tenants=2, max_shapes=2)
+    for k in range(6):
+        acct.record(f"t{k}", "interactive", f"shape{k}:boolean",
+                    {"device_us": 1.0})
+    snap = acct.snapshot()
+    assert set(snap["tenants"]) == {"t0", "t1", "overflow"}
+    assert snap["tenants"]["overflow"]["requests"] == 4
+    assert set(snap["shapes"]) == {"shape0:boolean", "shape1:boolean",
+                                   "other"}
+    # the system tenant never overflows: background cost must stay
+    # attributable even on a tenant-saturated box
+    acct.record_system("compaction", host_rows=500)
+    assert SYSTEM_TENANT in acct.snapshot()["tenants"]
+
+
+@obs
+def test_shapes_rollup_keeps_both_lanes_of_a_shared_shape():
+    """Two lanes legitimately sharing one shape string (the 'other'
+    overflow bucket exists in both) must not overwrite each other in
+    the /ops/costs shapes rollup — colliding entries render
+    lane-qualified (review fix)."""
+    acct = CostAccounting()
+    acct.record("a", "interactive", "other", {"device_us": 10.0})
+    acct.record("b", "bulk", "other", {"device_us": 99.0})
+    acct.record("a", "interactive", "solo:boolean", {"device_us": 5.0})
+    shapes = acct.snapshot()["shapes"]
+    assert "solo:boolean" in shapes  # unique shapes keep the bare key
+    assert "other|interactive" in shapes and "other|bulk" in shapes
+    assert shapes["other|interactive"]["units"] == 10.0
+    assert shapes["other|bulk"]["units"] == 99.0
+
+
+@obs
+def test_sealed_vector_redirects_late_charges_to_residue():
+    """A charge landing after the request folded (a launch completing
+    after its submitter 504ed) must appear in the attribution
+    DENOMINATOR — the residue — not vanish from both sides."""
+    v = CostVector()
+    v.add(device_us=10.0)
+    v.seal()
+    before = UNATTRIBUTED_COST.snapshot()["device_us"]
+    charge_cost_to_ctx = v  # the fetcher thread's captured vector
+    charge_cost_to_ctx.add(device_us=25.0)
+    assert v.snapshot()["device_us"] == 10.0  # unchanged post-seal
+    assert UNATTRIBUTED_COST.snapshot()["device_us"] == before + 25.0
+
+
+@obs
+def test_record_system_books_compaction_under_system_tenant():
+    acct = CostAccounting()
+    acct.record_system("compaction", host_rows=1000, delta_shards=4)
+    snap = acct.snapshot()
+    sys_doc = snap["tenants"][SYSTEM_TENANT]
+    assert sys_doc["host_rows"] == 1000
+    assert sys_doc["delta_shards"] == 4
+    assert snap["shapes"]["compaction"]["lane"] == "bulk"
+
+
+@obs
+def test_decaying_window_and_shape_cost_with_injectable_clock():
+    clk = [0.0]
+    acct = CostAccounting(window_s=80.0, clock=lambda: clk[0])
+    for _ in range(10):
+        acct.record("t", "interactive", "s:boolean",
+                    {"device_us": 200.0})
+    # enough window samples: the windowed mean serves
+    assert acct.shape_cost("interactive", "s:boolean") == pytest.approx(
+        200.0
+    )
+    # age the window out: lifetime mean takes over (same value here)
+    clk[0] = 1000.0
+    assert acct.shape_cost("interactive", "s:boolean") == pytest.approx(
+        200.0
+    )
+    # new traffic at a different cost: the window mean diverges from
+    # the lifetime mean — recency wins
+    for _ in range(10):
+        acct.record("t", "interactive", "s:boolean",
+                    {"device_us": 800.0})
+    assert acct.shape_cost("interactive", "s:boolean") == pytest.approx(
+        800.0
+    )
+    assert acct.shape_units()[("interactive", "s:boolean")] == (
+        pytest.approx(800.0)
+    )
+    # unknown shape / lane: 0 (the DRR hook maps that to flat 1.0)
+    assert acct.shape_cost("interactive", "nope") == 0.0
+
+
+@obs
+def test_drr_charge_normalizes_to_lane_mean_with_clamps():
+    acct = CostAccounting()
+    for _ in range(10):
+        acct.record("a", "interactive", "cheap:boolean",
+                    {"device_us": 100.0})
+    for _ in range(10):
+        acct.record("b", "interactive", "exp:record",
+                    {"device_us": 10_000.0})
+    # lane mean 5050: the cheap shape clamps at the floor, the
+    # expensive one lands just under the 2.0 ceiling
+    assert acct.drr_charge("interactive", "cheap:boolean") == 0.25
+    assert acct.drr_charge("interactive", "exp:record") == (
+        pytest.approx(10_000 / 5050, rel=1e-3)
+    )
+    assert acct.drr_charge("interactive", "unknown") == 1.0
+    assert acct.drr_charge("bulk", "cheap:boolean") == 1.0  # idle lane
+
+
+@obs
+def test_cost_metrics_render_with_tenant_labels():
+    acct = CostAccounting()
+    acct.record("gold", "interactive", "g:boolean",
+                {"device_us": 10.0, "host_rows": 5})
+    reg = MetricsRegistry()
+    acct.register_metrics(reg)
+    j = reg.render_json()
+    assert j["cost"]["units"]["gold"] > 0
+    assert j["cost"]["requests"] == {"gold": 1}
+    assert j["cost"]["host_rows"] == {"gold": 5}
+    text = reg.render_prometheus()
+    assert 'sbeacon_cost_units{tenant="gold"}' in text
+    assert (
+        'sbeacon_cost_shape_units{lane="interactive",shape="g:boolean"}'
+        in text
+    )
+
+
+# -- cost-aware DRR at the fair queue (the scheduling seam) --------------------
+
+
+def _grant_order(cost_fn, n_each=6):
+    """Saturate a 1-slot fair queue, enqueue ``n_each`` waiters for
+    tenants A (shape 'big') then B (shape 'small'), release the slot
+    and record the serialized grant order."""
+    q = FairQueueAdmission(
+        max_in_flight=1,
+        tenant_max_in_flight=1,
+        max_queue_wait_s=30.0,
+        cost_charge_fn=cost_fn,
+    )
+    q.acquire("sat", "interactive")  # hold the only slot
+    order = []
+    lock = threading.Lock()
+
+    def worker(tenant, shape):
+        with q.admit(tenant, "interactive", shape):
+            with lock:
+                order.append(tenant)
+
+    threads = []
+    for tenant, shape in (("A", "big"), ("B", "small")):
+        for _ in range(n_each):
+            t = threading.Thread(target=worker, args=(tenant, shape))
+            t.start()
+            threads.append(t)
+        # deterministic enqueue order: all of A queued before any B
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            if q.totals()["queued"] >= len(threads):
+                break
+            time.sleep(0.005)
+        assert q.totals()["queued"] == len(threads)
+    q.release("sat")  # start the serialized grant chain
+    for t in threads:
+        t.join(30)
+    assert len(order) == 2 * n_each, order
+    return order
+
+
+@obs
+def test_cost_drr_charges_expensive_shapes_more():
+    """With the cost hook charging shape 'big' 2x and 'small' 1x,
+    equal-weight tenants drain 1:2 by REQUESTS (equal by work) — and
+    without the hook the flat charge alternates 1:1, proving the
+    toggle changes scheduling only when armed."""
+    costs = {"big": 2.0, "small": 1.0}
+    order = _grant_order(lambda lane, shape: costs[shape])
+    first9 = order[:9]
+    assert first9.count("A") == 3 and first9.count("B") == 6, order
+    flat = _grant_order(None)
+    first8 = flat[:8]
+    assert abs(first8.count("A") - first8.count("B")) <= 1, flat
+
+
+# -- end-to-end through the API ------------------------------------------------
+
+
+@pytest.fixture()
+def app():
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.testing import random_records
+
+    app = BeaconApp()
+    rng = random.Random(11)
+    recs = random_records(rng, chrom="1", n=400, n_samples=2)
+    app.engine.add_index(
+        build_index(
+            recs,
+            dataset_id="ca",
+            vcf_location="ca.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+    )
+    app.store.upsert(
+        "datasets",
+        [
+            {
+                "id": "ca",
+                "name": "ca",
+                "_assemblyId": "GRCh38",
+                "_vcfLocations": ["synthetic://ca"],
+            }
+        ],
+    )
+    app._recs = recs
+    try:
+        yield app
+    finally:
+        app.close()
+
+
+def _q(rec, granularity="boolean"):
+    return {
+        "query": {
+            "requestedGranularity": granularity,
+            "requestParameters": {
+                "assemblyId": "GRCh38",
+                "referenceName": "1",
+                "start": [max(0, rec.pos - 1)],
+                "end": [rec.pos + 5],
+                "alternateBases": "N",
+            },
+        }
+    }
+
+
+@obs
+def test_ops_costs_golden_schema_and_attribution(app):
+    recs = app._recs
+    for k in range(3):
+        s, _ = app.handle(
+            "POST", "/g_variants", body=_q(recs[k]),
+            headers={"X-Beacon-Tenant": "gold"},
+        )
+        assert s == 200
+    s, _ = app.handle(
+        "POST", "/g_variants", body=_q(recs[0], "record"),
+        headers={"X-Beacon-Tenant": "bulkco"},
+    )
+    assert s == 200
+    status, doc = app.handle("GET", "/ops/costs")
+    assert status == 200
+    assert set(doc) == {
+        "enabled", "windowS", "costUnit", "totals", "unattributed",
+        "attributionRatio", "tenants", "topTenants", "shapes",
+        "costliestTenant", "costliestShape",
+    }
+    assert doc["enabled"] is True
+    assert doc["totals"]["requests"] >= 4
+    assert {"gold", "bulkco"} <= set(doc["tenants"])
+    # the device work and response bytes landed on the right tenants
+    assert doc["tenants"]["gold"]["requests"] == 3
+    assert doc["tenants"]["gold"].get("device_us", 0) > 0
+    assert doc["tenants"]["gold"].get("response_bytes", 0) > 0
+    # shapes carry lane + mean/p99 cost
+    assert "g_variants:boolean" in doc["shapes"]
+    assert doc["shapes"]["g_variants:boolean"]["lane"] == "interactive"
+    assert "g_variants:record" in doc["shapes"]
+    assert doc["shapes"]["g_variants:record"]["lane"] == "bulk"
+    assert set(doc["attributionRatio"]) == {"device_us", "host_rows"}
+    # probe routes never fold: /ops/costs itself adds no request
+    before = doc["totals"]["requests"]
+    app.handle("GET", "/ops/costs")
+    app.handle("GET", "/metrics")
+    _, doc2 = app.handle("GET", "/ops/costs")
+    assert doc2["totals"]["requests"] == before
+
+
+@obs
+def test_cache_hit_costs_less_and_is_stamped(app):
+    recs = app._recs
+    hdr = {"X-Beacon-Tenant": "cachet"}
+    app.handle("POST", "/g_variants", body=_q(recs[5]), headers=hdr)
+    _, doc1 = app.handle("GET", "/ops/costs")
+    cold = doc1["tenants"]["cachet"].get("device_us", 0.0)
+    # the repeat serves from the response cache: zero device launches
+    app.handle("POST", "/g_variants", body=_q(recs[5]), headers=hdr)
+    _, doc2 = app.handle("GET", "/ops/costs")
+    warm = doc2["tenants"]["cachet"].get("device_us", 0.0)
+    assert warm == pytest.approx(cold)  # no new device time charged
+    assert doc2["tenants"]["cachet"]["requests"] == 2
+
+
+@obs
+def test_slow_query_log_carries_cost_fields(tmp_path):
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        ObservabilityConfig,
+        StorageConfig,
+    )
+
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "store"),
+        observability=ObservabilityConfig(slow_query_ms=0.0),
+    )
+    cfg.storage.ensure()
+    app = BeaconApp(cfg)
+    try:
+        s, _ = app.handle("GET", "/info")
+        assert s == 200
+        entry = app.slow_log.recent()[-1]
+        assert entry["route"] == "info"
+        assert "cost" in entry["notes"], entry
+        # response bytes are always charged on tracked dict responses
+        assert entry["notes"]["cost"].get("response_bytes", 0) > 0
+    finally:
+        app.close()
+
+
+@obs
+def test_cost_accounting_disabled(tmp_path):
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        ObservabilityConfig,
+        StorageConfig,
+    )
+
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "store"),
+        observability=ObservabilityConfig(cost_accounting=False),
+    )
+    cfg.storage.ensure()
+    app = BeaconApp(cfg)
+    try:
+        assert app.accounting is None
+        status, doc = app.handle("GET", "/ops/costs")
+        assert status == 200 and doc == {"enabled": False}
+        # the cost.* catalogue series still exist (zeros)
+        assert "cost.units" in app.telemetry.names()
+        _, dbg = app.handle("GET", "/debug/status")
+        assert dbg["costs"] == {"enabled": False}
+        assert dbg["diagnosis"]["costliestTenant"] is None
+    finally:
+        app.close()
+
+
+@obs
+def test_compactor_cost_books_to_system_tenant(app):
+    comp = getattr(app.ingest, "compactor", None)
+    assert comp is not None
+    assert comp.accounting is app.accounting

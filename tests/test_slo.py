@@ -325,7 +325,7 @@ def test_debug_status_schema_and_diagnosis(app):
     assert status == 200
     assert set(doc) == {
         "ready", "beaconId", "slo", "breakers", "routing", "queues",
-        "ingest", "stages", "events", "diagnosis",
+        "ingest", "stages", "costs", "events", "diagnosis",
     }
     # ingest-while-serving rollup (ISSUE 10): delta-tail depth +
     # compactor counters; empty tails render as {}
@@ -338,8 +338,13 @@ def test_debug_status_schema_and_diagnosis(app):
     assert doc["queues"]["shaping"]["brownoutLevel"] == 0
     assert "materialize_ms" in doc["stages"]
     assert "admission_wait_ms" in doc["stages"]
+    # cost-accounting rollup (ISSUE 11): the /info request above is a
+    # tracked route, so at least one request folded
+    assert doc["costs"]["requests"] >= 1
+    assert "costliestTenant" in doc["costs"]
     assert set(doc["diagnosis"]) == {
         "breachedSlos", "openBreakers", "slowestStage", "slowestWorker",
+        "costliestTenant", "costliestShape",
     }
     assert set(doc["events"]) == {"lastSeq", "published"}
     # single-host app: no worker routing section content
@@ -353,6 +358,150 @@ def test_debug_status_names_slowest_stage(app):
     _, doc = app.handle("GET", "/debug/status")
     assert doc["stages"]["admission_wait_ms"]["p50"] == 125.0
     assert doc["diagnosis"]["slowestStage"] == "admission_wait_ms"
+
+
+# -- per-tenant SLO views (ISSUE 11) -------------------------------------------
+
+
+@obs
+def test_slo_tenant_view_golden_schema(app):
+    """/slo?tenant= serves the SAME burn-rate document shape, scoped
+    to one tenant's isolated rings, plus a 'tenant' field naming the
+    scope — and the unscoped /slo document is unchanged."""
+    app.handle(
+        "GET", "/g_variants", None, None, {"X-Beacon-Tenant": "gold"}
+    )
+    status, doc = app.handle("GET", "/slo", {"tenant": "gold"})
+    assert status == 200
+    assert set(doc) == {"alertBurnRate", "windows", "routes", "tenant"}
+    assert doc["tenant"] == "gold"
+    route = doc["routes"]["g_variants"]
+    assert set(route) == {"availability", "latency", "breached"}
+    for kind in ("availability", "latency"):
+        for wname in ("5m", "1h"):
+            win = route[kind]["windows"][wname]
+            assert set(win) == {
+                "good", "bad", "total", "badRatio", "burnRate",
+            }
+    assert route["availability"]["windows"]["5m"]["total"] >= 1
+    # a tenant with no recorded traffic serves an empty routes map,
+    # same schema — never a 404/500
+    status, doc = app.handle("GET", "/slo", {"tenant": "nobody"})
+    assert status == 200 and doc["routes"] == {}
+    # and the global document keeps its exact historical shape
+    status, doc = app.handle("GET", "/slo")
+    assert set(doc) == {"alertBurnRate", "windows", "routes"}
+
+
+@obs
+def test_slo_tenant_burn_isolation():
+    """Tenant A's 5xx storm must not move tenant B's burn view (and
+    both fold into the global rings)."""
+    clk = [0.0]
+    eng = _engine_at(clk)
+    for _ in range(10):
+        eng.record("g_variants", 500, 1.0, tenant="storm")
+    for _ in range(10):
+        eng.record("g_variants", 200, 1.0, tenant="calm")
+    storm = eng.snapshot(tenant="storm")["routes"]["g_variants"]
+    calm = eng.snapshot(tenant="calm")["routes"]["g_variants"]
+    assert storm["availability"]["windows"]["5m"]["bad"] == 10
+    assert storm["availability"]["windows"]["5m"]["burnRate"] > 0
+    assert calm["availability"]["windows"]["5m"]["bad"] == 0
+    assert calm["availability"]["windows"]["5m"]["burnRate"] == 0.0
+    assert calm["availability"]["windows"]["5m"]["good"] == 10
+    # the global view aggregates both
+    glob = eng.snapshot()["routes"]["g_variants"]
+    assert glob["availability"]["windows"]["5m"]["total"] == 20
+    assert eng.tenants() == ["calm", "storm"]
+
+
+@obs
+def test_slo_tenant_probe_route_exclusion_and_cardinality_cap():
+    clk = [0.0]
+    eng = _engine_at(clk, max_tenants=2)
+    # probe routes never carry objectives — tenant scoping included
+    eng.record("metrics", 500, 1.0, tenant="t0")
+    eng.record("ops.events", 500, 1.0, tenant="t0")
+    assert eng.snapshot(tenant="t0")["routes"] == {}
+    # cardinality: past max_tenants, new ids share the overflow bucket
+    for t in ("t0", "t1", "t2", "t3"):
+        eng.record("g_variants", 200, 1.0, tenant=t)
+    assert set(eng.tenants()) == {"t0", "t1", "overflow"}
+    over = eng.snapshot(tenant="t2")
+    assert over["tenant"] == "overflow"
+    assert (
+        over["routes"]["g_variants"]["availability"]["windows"]["5m"][
+            "total"
+        ]
+        == 2  # t2 and t3 both landed in the shared bucket
+    )
+
+
+@obs
+def test_slo_from_config_threads_the_shaping_tenant_cap():
+    """BEACON_MAX_TENANTS must bound EVERY tenant plane at the same
+    count: from_config threads shaping's cap into the SLO engine
+    (review fix — a fixed 64 here diverged from /ops/costs)."""
+    eng = SloEngine.from_config(ObservabilityConfig(), max_tenants=2)
+    assert eng.max_tenants == 2
+    for t in ("t0", "t1", "t2"):
+        eng.record("g_variants", 200, 1.0, tenant=t)
+    assert set(eng.tenants()) == {"t0", "t1", "overflow"}
+
+
+@obs
+def test_tenant_slo_rides_the_tenant_header_through_the_api(app):
+    app.handle(
+        "GET", "/g_variants", None, None, {"X-Beacon-Tenant": "acme"}
+    )
+    _, doc = app.handle("GET", "/slo", {"tenant": "acme"})
+    assert "g_variants" in doc["routes"]
+
+
+# -- /ops/events kind list (ISSUE 11 satellite) --------------------------------
+
+
+@obs
+def test_event_journal_kind_accepts_comma_list():
+    """Operators correlating two control planes (compaction vs
+    brownout) tail ONE interleaved stream: ?kind=a,b matches either,
+    each by the usual exact-or-prefix rule."""
+    j = EventJournal(keep=16)
+    j.publish("compaction.start", dataset="d0")
+    j.publish("shaping.brownout", level=1)
+    j.publish("breaker.open", route="w1")
+    j.publish("compaction.complete", dataset="d0")
+    kinds = [
+        e["kind"]
+        for e in j.events(kind="compaction,shaping.brownout")
+    ]
+    assert kinds == [
+        "compaction.start", "shaping.brownout", "compaction.complete",
+    ]
+    # single-filter behaviour unchanged; whitespace tolerated
+    assert [e["kind"] for e in j.events(kind="breaker")] == [
+        "breaker.open"
+    ]
+    assert [
+        e["kind"] for e in j.events(kind=" compaction , nope ")
+    ] == ["compaction.start", "compaction.complete"]
+
+
+@obs
+def test_ops_events_kind_list_through_the_api(app):
+    seq0 = journal.last_seq()
+    publish_event("compaction.start", dataset="dx")
+    publish_event("shaping.brownout", level=2)
+    publish_event("breaker.open", route="wz")
+    status, doc = app.handle(
+        "GET",
+        "/ops/events",
+        {"since": str(seq0), "kind": "compaction,shaping.brownout"},
+    )
+    assert status == 200
+    kinds = [e["kind"] for e in doc["events"]]
+    assert kinds == ["compaction.start", "shaping.brownout"]
 
 
 # -- the acceptance integration ------------------------------------------------

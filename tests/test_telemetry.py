@@ -216,6 +216,14 @@ GOLDEN_METRICS = [
     "slo.latency_burn_rate",
     "slo.breached",
     "events.published",
+    "cost.requests",
+    "cost.units",
+    "cost.device_us",
+    "cost.host_rows",
+    "cost.worker_rtt_ms",
+    "cost.response_bytes",
+    "cost.shape_units",
+    "telemetry.label_overflow",
 ]
 
 
@@ -471,6 +479,73 @@ def test_error_envelopes_carry_trace_id(app):
     assert re.fullmatch(r"[0-9a-f]{16}", body["meta"]["traceId"])
 
 
+# -- label-cardinality guard (ISSUE 11 satellite) ------------------------------
+
+
+@obs
+def test_label_cardinality_guard_counter_collapses_to_other():
+    """A value-owning labeled series mints at most max_label_values
+    distinct labels; overflow collapses to 'other' and ticks
+    telemetry.label_overflow{family=...} — the registry-level twin of
+    shaping's tenant cap, so NO producer can mint unbounded series."""
+    reg = MetricsRegistry()
+    c = reg.counter("t.by_tenant", label="tenant", max_label_values=4)
+    for k in range(10):
+        c.inc(label_value=f"tenant{k}")
+    j = reg.render_json()
+    series = j["t"]["by_tenant"]
+    assert len(series) == 5  # 4 real + the shared "other"
+    assert series["other"] == 6
+    # established label values keep accumulating after the cap
+    c.inc(label_value="tenant0")
+    assert reg.render_json()["t"]["by_tenant"]["tenant0"] == 2
+    overflow = reg.render_json()["telemetry"]["label_overflow"]
+    assert overflow == {"t.by_tenant": 6}
+
+
+@obs
+def test_label_cardinality_guard_gauge_and_histogram():
+    reg = MetricsRegistry()
+    g = reg.gauge("t.depth_by", label="k", max_label_values=2)
+    for k in range(5):
+        g.set(float(k), label_value=f"k{k}")
+    series = reg.render_json()["t"]["depth_by"]
+    assert set(series) == {"k0", "k1", "other"}
+    assert series["other"] == 4.0  # last overflow write wins (gauge)
+    h = reg.histogram("t.lat_by", label="route", max_label_values=2)
+    for k in range(5):
+        h.observe(1.0, label_value=f"r{k}")
+    hseries = h.collect()
+    assert set(hseries) == {"r0", "r1", "other"}
+    assert hseries["other"]["count"] == 3
+    overflow = reg.render_json()["telemetry"]["label_overflow"]
+    assert overflow == {"t.depth_by": 3, "t.lat_by": 3}
+
+
+@obs
+def test_label_guard_default_cap_is_64():
+    reg = MetricsRegistry()
+    c = reg.counter("t.default_cap", label="k")
+    for k in range(70):
+        c.inc(label_value=f"k{k:03d}")
+    series = reg.render_json()["t"]["default_cap"]
+    assert len(series) == 65  # 64 + "other"
+    assert series["other"] == 6
+
+
+@obs
+def test_callback_backed_series_are_exempt_from_the_guard():
+    # fn-backed instruments render whatever the producer owns — the
+    # producer bounds its own state (shaping's tenant cap etc.)
+    reg = MetricsRegistry()
+    reg.gauge(
+        "t.fn_backed",
+        label="k",
+        fn=lambda: {f"k{i}": i for i in range(80)},
+    )
+    assert len(reg.render_json()["t"]["fn_backed"]) == 80
+
+
 # -- metric-name lint (CI wiring for tools/check_metric_names.py) -------------
 
 
@@ -503,3 +578,41 @@ def test_metric_name_lint_catches_violations():
         ]
     )
     assert len(errors) == 3
+
+
+# -- annotation-key lint (ISSUE 11 satellite) ----------------------------------
+
+
+@obs
+def test_annotation_key_lint():
+    """Every annotate(...) key under sbeacon_tpu/ must appear in the
+    literal telemetry.ANNOTATION_KEYS registry, and every registered
+    key must be used — two-way parity, like the metric catalogue."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_annotation_keys.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@obs
+def test_annotation_key_lint_catches_violations():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_annotation_keys import lint as akl_lint
+    finally:
+        sys.path.pop(0)
+
+    registry = {"tenant", "lane", "unused_key"}
+    errors = akl_lint(
+        {"tenant": ["a.py:1"], "bogus": ["b.py:2"]}, registry
+    )
+    # one unregistered key + one registered-but-unused x2 (lane too)
+    assert any("bogus" in e for e in errors)
+    assert any("unused_key" in e for e in errors)
+    assert any("lane" in e for e in errors)
+    assert akl_lint({"tenant": ["a.py:1"]}, None)  # missing registry
+    assert akl_lint({}, registry)  # no call sites at all

@@ -1,0 +1,159 @@
+#!/usr/bin/env python
+"""Static annotation-key lint for the telemetry plane (ISSUE 11).
+
+``telemetry.annotate(...)`` keys are the slow-query log's schema: a
+dashboard (or an operator's jq one-liner) keys on them exactly like
+metric names, so they must be as auditable. This tool mirrors
+``check_metric_names.py`` for the annotation surface:
+
+- every keyword passed to an ``annotate(...)`` call anywhere under
+  ``sbeacon_tpu/`` must appear in the literal registry
+  ``telemetry.ANNOTATION_KEYS`` (an unregistered key is an invisible
+  note nobody will chart),
+- ``annotate(**dynamic)`` is rejected — a computed key set cannot be
+  audited statically,
+- every registered key must be USED by at least one call site (a
+  registered-but-unused key is a dashboard field that silently
+  flatlined) — two-way parity, like the metric catalogue.
+
+The registry is read from ``telemetry.py`` by AST (no package import —
+the lint must run in a bare interpreter). Run directly
+(``python tools/check_annotation_keys.py``) or via the tier-1 test
+``tests/test_telemetry.py::test_annotation_key_lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+TELEMETRY = PKG / "telemetry.py"
+
+
+def registry_keys(path: Path = TELEMETRY) -> set[str] | None:
+    """The literal ``ANNOTATION_KEYS`` frozenset from telemetry.py, or
+    None when the assignment is missing/non-literal (itself a lint
+    failure)."""
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "ANNOTATION_KEYS"
+            for t in node.targets
+        ):
+            continue
+        try:
+            value = ast.literal_eval(node.value)
+        except ValueError:
+            # frozenset({...}) is a Call, not a literal — evaluate its
+            # single literal argument instead
+            call = node.value
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == "frozenset"
+                and len(call.args) == 1
+            ):
+                try:
+                    value = ast.literal_eval(call.args[0])
+                except ValueError:
+                    return None
+            else:
+                return None
+        return {str(v) for v in value}
+    return None
+
+
+def scan(root: Path = PKG) -> tuple[dict[str, list[str]], list[str]]:
+    """({key: [call sites]}, [errors]) over every ``annotate(...)``
+    call under ``root`` (calls of a bare name or attribute named
+    ``annotate``)."""
+    used: dict[str, list[str]] = {}
+    errors: list[str] = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root.parent)
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as e:  # pragma: no cover - broken tree
+            errors.append(f"{rel}: unparseable ({e})")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id
+                if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name != "annotate":
+                continue
+            where = f"{rel}:{node.lineno}"
+            if node.args:
+                errors.append(
+                    f"{where}: annotate() takes keyword notes only "
+                    "(positional args cannot be audited)"
+                )
+            for kw in node.keywords:
+                if kw.arg is None:
+                    errors.append(
+                        f"{where}: annotate(**dynamic) — keys must be "
+                        "literal keywords so they can be audited"
+                    )
+                    continue
+                used.setdefault(kw.arg, []).append(where)
+    return used, errors
+
+
+def lint(
+    used: dict[str, list[str]], registry: set[str] | None
+) -> list[str]:
+    if registry is None:
+        return [
+            "telemetry.py: ANNOTATION_KEYS literal frozenset not found "
+            "— the annotation-key registry must be a plain literal so "
+            "this lint can parse it"
+        ]
+    errors = []
+    for key in sorted(set(used) - registry):
+        sites = ", ".join(used[key][:3])
+        errors.append(
+            f"annotation key {key!r} (used at {sites}) is not in "
+            "telemetry.ANNOTATION_KEYS — register it or fix the typo"
+        )
+    for key in sorted(registry - set(used)):
+        errors.append(
+            f"telemetry.ANNOTATION_KEYS documents {key!r} but no "
+            "annotate() call site uses it — drop it or it is drift"
+        )
+    if not used:
+        errors.append(
+            "no annotate() call sites found under sbeacon_tpu/ — "
+            "either the telemetry plane was removed or this tool's "
+            "scan drifted from the idiom"
+        )
+    return errors
+
+
+def main() -> int:
+    used, errors = scan()
+    errors += lint(used, registry_keys())
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}")
+        return 1
+    print(
+        f"ok: {sum(len(v) for v in used.values())} annotate() sites, "
+        f"{len(used)} distinct keys, registry in sync"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
