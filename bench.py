@@ -2486,6 +2486,208 @@ def config15_cost():
             app.close()
 
 
+def config16_fleet():
+    """Fleet observability overhead + canary time-to-detect (ISSUE
+    12): a coordinator + 2-replica fleet serving boolean queries. The
+    serving p99 with the observability plane ACTIVE (canary rounds +
+    /fleet/status digest polls at an aggressive cadence) must stay
+    within noise of the plane-off run, and a seeded stale-replica
+    fault (one replica's delta tail dropped in place — silently wrong
+    data, identical advertised identity) must surface as a
+    canary.mismatch flight-recorder event within ~one probe
+    interval."""
+    import random as _random
+    import tempfile
+    import threading
+    import time as _time
+    from pathlib import Path
+
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        ObservabilityConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.parallel.dispatch import (
+        DistributedEngine,
+        WorkerServer,
+    )
+    from sbeacon_tpu.telemetry import journal
+    from sbeacon_tpu.testing import random_records
+
+    rng = _random.Random(1600)
+    recs = random_records(rng, chrom="1", n=2000, n_samples=2)
+    base, tail = recs[:1800], recs[1800:]
+
+    def mk_engine():
+        eng = VariantEngine(
+            BeaconConfig(
+                engine=EngineConfig(
+                    microbatch=False, use_mesh=False, device_planes=False
+                )
+            )
+        )
+        eng.add_index(
+            build_index(
+                base,
+                dataset_id="fl0",
+                vcf_location="fl0.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+        eng.add_delta(
+            build_index(
+                tail,
+                dataset_id="fl0",
+                vcf_location="fl0.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+        return eng
+
+    stale_engine = mk_engine()
+    w1 = WorkerServer(mk_engine()).start_background()
+    w2 = WorkerServer(stale_engine).start_background()
+    tmp_kw = {"prefix": "bench-fleet-"}
+    if Path("/dev/shm").is_dir():
+        tmp_kw["dir"] = "/dev/shm"
+    with tempfile.TemporaryDirectory(**tmp_kw) as td:
+        cfg = BeaconConfig(
+            storage=StorageConfig(root=Path(td)),
+            engine=EngineConfig(
+                microbatch=False, use_mesh=False, device_planes=False
+            ),
+            observability=ObservabilityConfig(
+                # the prober thread is driven explicitly below so the
+                # off-phase really is plane-off
+                canary_enabled=False,
+                canary_interval_s=0.25,
+                fleet_digest_interval_s=0.25,
+            ),
+        )
+        cfg.storage.ensure()
+        local = mk_engine()
+        dist = DistributedEngine(
+            [w1.address, w2.address], local=local, config=cfg
+        )
+        app = BeaconApp(cfg, engine=dist)
+        app.store.upsert(
+            "datasets",
+            [
+                {
+                    "id": "fl0",
+                    "name": "fl0",
+                    "_assemblyId": "GRCh38",
+                    "_vcfLocations": ["fl0.vcf.gz"],
+                }
+            ],
+        )
+        dist.replica_table()
+        pos = [int(r.pos) for r in base]
+
+        def query(k: int):
+            p = pos[k % 64]
+            return {
+                "query": {
+                    "requestedGranularity": "boolean",
+                    "requestParameters": {
+                        "assemblyId": "GRCh38",
+                        "referenceName": "1",
+                        "start": [max(0, p - 1)],
+                        "end": [p + 2],
+                        "alternateBases": "N",
+                    },
+                }
+            }
+
+        def measure(n):
+            lat = []
+            for k in range(n):
+                t0 = _time.perf_counter()
+                s, _b = app.handle("POST", "/g_variants", body=query(k))
+                lat.append((_time.perf_counter() - t0) * 1e3)
+                assert s == 200
+            lat.sort()
+            return (
+                round(lat[len(lat) // 2], 3),
+                round(lat[int(0.99 * (len(lat) - 1))], 3),
+            )
+
+        try:
+            measure(64)  # warm both phases' working set
+            off_p50, off_p99 = measure(300)
+            # plane ON: canary rounds + digest polls at an aggressive
+            # cadence on a driver thread while the same traffic runs
+            app.canary.sync_probes()
+            stop = threading.Event()
+
+            def driver():
+                while not stop.is_set():
+                    try:
+                        app.canary.run_once()
+                        app.handle("GET", "/fleet/status")
+                    except Exception:
+                        pass
+                    stop.wait(0.25)
+
+            drv = threading.Thread(target=driver, daemon=True)
+            drv.start()
+            try:
+                on_p50, on_p99 = measure(300)
+                # seeded stale-replica fault: drop one replica's delta
+                # tail in place; the driver's next canary round must
+                # flag the known-hit probe against that replica
+                seq0 = journal.last_seq()
+                t_fault = _time.perf_counter()
+                with stale_engine._mesh_lock:
+                    stale_engine._deltas = {}
+                    stale_engine._rebuild_serving_state_locked()
+                detect_s = None
+                deadline = _time.time() + 10.0
+                while _time.time() < deadline:
+                    evs = journal.events(
+                        since=seq0, kind="canary.mismatch"
+                    )
+                    if evs:
+                        detect_s = _time.perf_counter() - t_fault
+                        break
+                    _time.sleep(0.02)
+            finally:
+                stop.set()
+                drv.join(5)
+            canary = app.canary.counters()
+            fleet = dist.fleet.stats()
+            return {
+                "p50_plane_off_ms": off_p50,
+                "p99_plane_off_ms": off_p99,
+                "p50_plane_on_ms": on_p50,
+                "p99_plane_on_ms": on_p99,
+                # scheduling noise dominates at sub-ms scale on this
+                # box: the honest bound mirrors config14/15 (ratio OR
+                # an absolute floor)
+                "p99_within_2x_off_or_25ms": bool(
+                    on_p99 <= max(2 * off_p99, 25.0)
+                ),
+                "canary_probes": canary["probes"],
+                "canary_mismatches": canary["mismatches"],
+                "digest_polls": fleet["polls"],
+                "canary_detect_s": (
+                    None if detect_s is None else round(detect_s, 3)
+                ),
+                "detect_within_one_interval": bool(
+                    detect_s is not None and detect_s <= 1.0
+                ),
+            }
+        finally:
+            app.close()
+            dist.close()
+            w1.shutdown()
+            w2.shutdown()
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -2620,6 +2822,7 @@ def main() -> None:
     run("config13_pod", 60, config13_pod)
     run("config14_ingest_serve", 90, config14_ingest_serve)
     run("config15_cost", 45, config15_cost)
+    run("config16_fleet", 45, config16_fleet)
     emit(final=True)
 
 

@@ -25,6 +25,7 @@ import time
 from pathlib import Path
 
 from ..accounting import CostAccounting, disabled_snapshot, query_shape
+from ..canary import CanaryProber
 from ..config import BeaconConfig, StorageConfig
 from ..engine import VariantEngine
 from ..ingest import IngestService
@@ -43,7 +44,12 @@ from ..resilience import (
     register_breaker_metrics,
 )
 from ..shaping import TrafficShaper, requested_granularity
-from ..slo import SloEngine
+from ..slo import (
+    DIAGNOSTIC_ROUTE_LABELS,
+    PROBE_BYPASS_PATHS,
+    PROBE_HEAD_LABELS,
+    SloEngine,
+)
 from ..telemetry import (
     MetricsRegistry,
     RequestContext,
@@ -280,6 +286,18 @@ class BeaconApp:
             obs, max_tenants=self.config.shaping.max_tenants
         )
         self.slo.add_breach_listener(self.shaping.on_slo_signal)
+        # known-answer canary prober (canary.py): expected-answer
+        # probes derived from the serving snapshot, run per query
+        # shape x dispatch path under the synthetic 'canary' route —
+        # budget- and cost-excluded like every probe. The thread waits
+        # one full interval before its first round.
+        self.canary = CanaryProber(
+            self.engine,
+            interval_s=getattr(obs, "canary_interval_s", 30.0),
+            enabled=getattr(obs, "canary_enabled", True),
+            latency_ms=getattr(obs, "canary_latency_ms", 1000.0),
+        )
+        self.canary.start()
         # flight recorder: the process journal was built from env
         # defaults at import; the config tier re-applies here (like
         # profiler.directory) so BEACON_EVENT_JOURNAL_* and explicit
@@ -325,6 +343,7 @@ class BeaconApp:
         separately when this app owns it."""
         self.query_runner.close()
         self.query_jobs.close()
+        self.canary.close()
         shaper_close = getattr(self.shaping, "close", None)
         if shaper_close is not None:
             shaper_close()
@@ -370,6 +389,7 @@ class BeaconApp:
             "control-plane events published to the flight recorder",
             fn=journal.published,
         )
+        self.canary.register_metrics(reg)
         register_admission_metrics(reg, lambda: self.admission)
         self.shaping.register_metrics(reg)
         self.query_runner.register_metrics(reg)
@@ -417,25 +437,36 @@ class BeaconApp:
             lambda: getattr(self.ingest, "compaction_metrics", dict)(),
         )
 
+    #: heads of the two-segment diagnostic surfaces (``ops``,
+    #: ``debug``, ``fleet``) — derived from the ONE probe-route source
+    #: in slo.py (tools/check_probe_routes.py enforces the derivation)
+    _DIAG_HEADS = frozenset(
+        label.split(".", 1)[0] for label in DIAGNOSTIC_ROUTE_LABELS
+    )
+
     #: bounded route-label set for the latency histogram — unknown
-    #: paths collapse to "other" so a URL scanner cannot mint series
-    _ROUTE_HEADS = ENTITY_PATHS | {
-        "info",
-        "configuration",
-        "map",
-        "entry_types",
-        "filtering_terms",
-        "schemas",
-        "submit",
-        "g_variants",
-        "health",
-        "ready",
-        "metrics",
-        "slo",
-        "ops",
-        "debug",
-        "_trace",
-    }
+    #: paths collapse to "other" so a URL scanner cannot mint series.
+    #: Probe heads derive from slo.PROBE_ROUTE_LABELS, the single
+    #: literal source shared with the SLO budget exclusion and the
+    #: auth/admission bypass set.
+    _ROUTE_HEADS = (
+        ENTITY_PATHS
+        | {
+            "info",
+            "configuration",
+            "map",
+            "entry_types",
+            "filtering_terms",
+            "schemas",
+            "submit",
+            "g_variants",
+        }
+        | {
+            label.split(".", 1)[0]
+            for label in DIAGNOSTIC_ROUTE_LABELS
+        }
+        | PROBE_HEAD_LABELS
+    )
 
     def _route_label(self, path: str) -> str:
         parts = [p for p in path.strip("/").split("/") if p]
@@ -446,15 +477,13 @@ class BeaconApp:
             return "other"
         if len(parts) == 1:
             return head
-        if head in ("ops", "debug"):
+        if head in self._DIAG_HEADS:
             # diagnostic surfaces: only the KNOWN two-segment paths get
             # named labels — /ops/<anything-else> must collapse like
             # any other unknown path or a scanner mints series
             label = f"{head}.{parts[1]}"
             return (
-                label
-                if label in ("ops.events", "ops.costs", "debug.status")
-                else "other"
+                label if label in DIAGNOSTIC_ROUTE_LABELS else "other"
             )
         sub = parts[-1]
         if sub in ("filtering_terms", "g_variants", "biosamples",
@@ -563,20 +592,18 @@ class BeaconApp:
         try:
             with span("api.handle", path=path, method=method):
                 head = path.strip("/")
-                if method.upper() == "GET" and head in (
-                    "health",
-                    "ready",
-                    "metrics",
-                    "slo",
-                    "ops/events",
-                    "ops/costs",
-                    "debug/status",
+                if (
+                    method.upper() == "GET"
+                    and head in PROBE_BYPASS_PATHS
                 ):
                     # probes/metrics AND the self-diagnosis surfaces
                     # bypass auth, admission and deadlines: a flight
                     # recorder that stops answering exactly when the
                     # server is saturated or shedding is useless —
-                    # answering then is their whole job
+                    # answering then is their whole job. The path set
+                    # derives from slo.PROBE_ROUTE_LABELS — the SAME
+                    # source that excludes these routes from SLO
+                    # budgets and the cost fold below.
                     return self._probe(head, query_params, headers)
                 denied = self._check_auth(method.upper(), path, headers)
                 if denied is not None:
@@ -698,6 +725,12 @@ class BeaconApp:
             if self.accounting is None:
                 return 200, disabled_snapshot()
             return 200, self.accounting.snapshot()
+        if head == "fleet/status":
+            # fleet-wide federation rollup: every worker's /ops/digest
+            # collected at a bounded cadence + the coordinator's own
+            # digest, with a fleet-level diagnosis (stalest replica,
+            # hottest worker, divergent fingerprints)
+            return 200, self._fleet_status()
         if head == "debug/status":
             return 200, self._debug_status()
         # /metrics: content negotiation — ?format=openmetrics or an
@@ -718,8 +751,13 @@ class BeaconApp:
 
     def _ops_events(self, query_params: dict | None) -> tuple[int, dict]:
         """The flight recorder, filtered: ``?since=<seq>`` returns only
-        newer events (pass the previous response's ``lastSeq`` to
-        tail), ``?kind=breaker`` filters by kind prefix."""
+        newer events — the OLDEST ``limit`` of them, with a
+        ``nextSince`` cursor to pass back as ``since``, so a tailing
+        client pages forward through a burst without re-reading or
+        silently skipping the middle (ISSUE 12 satellite; previously
+        the newest ``limit`` were served and a tailer had to guess the
+        resume point). ``?kind=breaker`` filters by kind prefix
+        (comma-separated list accepted)."""
         qp = query_params or {}
         try:
             since = int(qp.get("since") or 0)
@@ -728,14 +766,69 @@ class BeaconApp:
             return 400, self.env.error(
                 400, "since/limit must be integers"
             )
+        events, next_since = journal.events_page(
+            since=since, kind=str(qp.get("kind") or ""), limit=limit
+        )
         return 200, {
-            "events": journal.events(
-                since=since, kind=str(qp.get("kind") or ""), limit=limit
-            ),
+            "events": events,
+            "nextSince": next_since,
             "lastSeq": journal.last_seq(),
             "published": journal.published(),
             "enabled": journal.enabled,
         }
+
+    def _digest_extras(self) -> dict:
+        """The coordinator's app-tier digest fields (the worker digest
+        carries engine fields only): SLO breaches, slow-query count,
+        top cost tenants, canary rollup."""
+        canary = self.canary.counters()
+        extras = {
+            "sloBreached": self.slo.breached_routes(),
+            "slowQueries": self.slow_log.count(),
+            "canary": {
+                "mismatches": canary["mismatches"],
+                "failures": canary["failures"],
+            },
+        }
+        if self.accounting is not None:
+            extras["topCostTenants"] = self.accounting.snapshot(
+                top_n=3
+            )["topTenants"]
+        else:
+            extras["topCostTenants"] = []
+        return extras
+
+    def _fleet_status(self) -> dict:
+        """The ``/fleet/status`` document: the FleetView's per-worker
+        digest rollup + diagnosis (fan-out engines), always including
+        the coordinator's own digest as ``local`` — a single-host
+        deployment serves the same schema with an empty worker map."""
+        from ..parallel.dispatch import ops_digest
+
+        local_engine = getattr(self.engine, "local", None) or self.engine
+        local = ops_digest(local_engine, extras=self._digest_extras())
+        fleet = getattr(self.engine, "fleet", None)
+        if fleet is None:
+            doc = {
+                "intervalS": getattr(
+                    self.config.observability,
+                    "fleet_digest_interval_s",
+                    10.0,
+                ),
+                "polls": 0,
+                "lastPollAgeS": None,
+                "workers": {},
+                "diagnosis": {
+                    "stalestReplica": None,
+                    "hottestWorker": None,
+                    "divergentDatasets": {},
+                    "unreachableWorkers": [],
+                },
+            }
+        else:
+            doc = fleet.snapshot()
+        doc["local"] = local
+        return doc
 
     def _debug_status(self) -> dict:
         """The self-diagnosis rollup: SLO state, breaker states,
@@ -821,6 +914,10 @@ class BeaconApp:
             if self.accounting is not None
             else {"enabled": False}
         )
+        # canary rollup (ISSUE 12): the known-answer prober's state —
+        # a mismatch here means the data plane is SILENTLY WRONG, the
+        # one failure mode no latency or availability signal shows
+        canary = self.canary.status()
         return {
             "ready": bool(self.ready),
             "beaconId": self.config.info.beacon_id,
@@ -831,6 +928,7 @@ class BeaconApp:
             "ingest": ingest,
             "stages": stages,
             "costs": costs,
+            "canary": canary,
             "events": {
                 "lastSeq": journal.last_seq(),
                 "published": journal.published(),
@@ -848,6 +946,7 @@ class BeaconApp:
                 ),
                 "costliestTenant": costs.get("costliestTenant"),
                 "costliestShape": costs.get("costliestShape"),
+                "canaryMismatches": list(canary.get("mismatched", [])),
             },
         }
 

@@ -420,6 +420,17 @@ class ObservabilityConfig:
     cost_window_s: the decaying window the per-shape mean cost (and
       the DRR charge hook) is computed over.
     Tenant cardinality reuses shaping's ``max_tenants`` cap.
+
+    Fleet observability & canaries (ISSUE 12):
+    fleet_digest_interval_s: minimum seconds between worker
+      ``/ops/digest`` collection passes behind ``/fleet/status``
+      (digests are polled lazily, at most once per interval).
+    canary_enabled / canary_interval_s: the known-answer canary prober
+      (canary.py) — background expected-answer probes per dataset x
+      query shape x dispatch path; interval <= 0 disables the thread
+      (explicit ``run_once()`` still works).
+    canary_latency_ms: a correct probe slower than this ticks
+      ``canary.slow_probes``.
     """
 
     slow_query_ms: float = 1000.0
@@ -434,6 +445,10 @@ class ObservabilityConfig:
     event_journal_size: int = 1024
     cost_accounting: bool = True
     cost_window_s: float = 300.0
+    fleet_digest_interval_s: float = 10.0
+    canary_enabled: bool = True
+    canary_interval_s: float = 30.0
+    canary_latency_ms: float = 1000.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -643,6 +658,12 @@ class BeaconConfig:
             "BEACON_SLO_ROUTES": ("slo_routes", str),
             "BEACON_SLO_ALERT_BURN": ("slo_alert_burn_rate", float),
             "BEACON_EVENT_JOURNAL_SIZE": ("event_journal_size", int),
+            "BEACON_FLEET_DIGEST_INTERVAL_S": (
+                "fleet_digest_interval_s",
+                float,
+            ),
+            "BEACON_CANARY_INTERVAL_S": ("canary_interval_s", float),
+            "BEACON_CANARY_LATENCY_MS": ("canary_latency_ms", float),
         }
         for var, (field, conv) in _obs_env.items():
             if var in env:
@@ -650,6 +671,10 @@ class BeaconConfig:
         if "BEACON_EVENT_JOURNAL_ENABLED" in env:
             obs_over["event_journal"] = (
                 env["BEACON_EVENT_JOURNAL_ENABLED"].lower() not in _off
+            )
+        if "BEACON_CANARY_ENABLED" in env:
+            obs_over["canary_enabled"] = (
+                env["BEACON_CANARY_ENABLED"].lower() not in _off
             )
         if "BEACON_COST_ACCOUNTING" in env:
             obs_over["cost_accounting"] = (

@@ -1312,6 +1312,94 @@ class VariantEngine:
             if not dataset_ids or ds in dataset_ids:
                 yield ds, vcf, triple
 
+    @staticmethod
+    def _delta_epoch_of(vcf_label: str) -> int:
+        """-1 for a base serve-list label, else the ``#d<epoch>``."""
+        _base, sep, epoch = vcf_label.rpartition("#d")
+        if not sep:
+            return -1
+        try:
+            return int(epoch)
+        except ValueError:
+            return -1
+
+    def canary_brackets(self) -> dict[str, dict]:
+        """Per-dataset known-answer probe source (canary.py): one
+        representative row per dataset — canonical chromosome, exact
+        start position and alt allele — whose presence the serving
+        snapshot guarantees (the known-HIT bracket), plus the
+        dataset's coordinate ceiling on that chromosome across every
+        serving shard, so a bracket strictly beyond it is a known
+        MISS. Rows come from the NEWEST serving shard that has a
+        plain-allele row (delta tail first, base last): a probe
+        derived from the freshest publish is exactly the staleness
+        canary — a replica whose delta tail was lost or corrupted
+        fails it. Lock-free over the copy-on-write serve list, like
+        every diagnostic read."""
+        serve = self._serve_list
+        by_ds: dict[str, list[tuple[int, object, str]]] = {}
+        ceilings: dict[tuple[str, str], int] = {}
+        for ds, vcf, (shard, _di, _pl) in serve:
+            by_ds.setdefault(ds, []).append(
+                (self._delta_epoch_of(vcf), shard, vcf)
+            )
+            for chrom, _lo, hi in shard_regions(shard):
+                key = (ds, chrom)
+                ceilings[key] = max(ceilings.get(key, 0), hi)
+        out: dict[str, dict] = {}
+        for ds, shards in by_ds.items():
+            # a PLAIN-allele row is REQUIRED for the hit probe: an
+            # exact alternate_bases compare serves identically on every
+            # dispatch path, while symbolic alts (<CN2>, <DEL>) only
+            # match via variant_type queries — a symbolic hit probe
+            # would be a permanent false canary.mismatch alarm. Walk
+            # shards NEWEST first (deepest delta epoch down to base):
+            # the freshest publish with a plain row anchors the probe,
+            # so a symbolic-only delta does not silently drop the
+            # coverage an older shard can still provide. A dataset
+            # with no plain row in ANY shard gets the miss probe only.
+            row = None
+            chrom = None
+            hit_shard = None
+            source = None
+            for _epoch, shard, vcf in sorted(
+                shards, key=lambda t: t[0], reverse=True
+            ):
+                for rchrom, _lo, _hi in shard_regions(shard):
+                    code = chromosome_code(rchrom)
+                    lo = int(shard.chrom_offsets[code])
+                    hi = int(shard.chrom_offsets[code + 1])
+                    flags = np.asarray(shard.cols["flags"][lo:hi])
+                    plain = np.nonzero((flags & FLAG.SYMBOLIC) == 0)[0]
+                    if plain.size:
+                        row = lo + int(plain[0])
+                        chrom = rchrom
+                        hit_shard = shard
+                        source = vcf
+                        break
+                if row is not None:
+                    break
+            if chrom is None:
+                # no plain row anywhere: anchor the miss bracket on
+                # the newest shard's first populated region instead
+                _e, shard, vcf = max(shards, key=lambda t: t[0])
+                regions = shard_regions(shard)
+                if not regions:
+                    continue
+                chrom = regions[0][0]
+                source = vcf
+            bracket = {
+                "chrom": chrom,
+                "maxEnd": ceilings[(ds, chrom)],
+                "source": source,
+            }
+            if row is not None:
+                alt = hit_shard.row_alt(row)
+                bracket["pos"] = int(hit_shard.cols["pos"][row])
+                bracket["alt"] = alt if alt else "N"
+            out[ds] = bracket
+        return out
+
     # -- query path ---------------------------------------------------------
 
     def search(self, payload: VariantQueryPayload) -> list[VariantSearchResponse]:
@@ -1329,7 +1417,14 @@ class VariantEngine:
         continuous ingest. The generation captured before dispatch
         stops a publish that lands mid-search from being outrun by a
         stale store."""
-        cache = self._response_cache
+        # probe traffic may bypass the cache outright (payload flag):
+        # a canary asserting freshness must read the live data plane,
+        # not the answer the cache remembered
+        cache = (
+            None
+            if getattr(payload, "no_response_cache", False)
+            else self._response_cache
+        )
         key = None
         scope = None
         gen = None

@@ -75,11 +75,12 @@ from ..telemetry import (
     annotate,
     charge_cost,
     current_context,
+    new_span_id,
     publish_event,
     request_context,
     sanitize_trace_id,
 )
-from ..utils.trace import span
+from ..utils.trace import Span, span
 
 log = logging.getLogger(__name__)
 
@@ -160,6 +161,13 @@ def _make_handler(
                 self._send(200, {"ok": True})
             elif not self._authorized():
                 self._send(401, {"error": "unauthorized"})
+            elif self.path == "/ops/digest":
+                # the fleet-federation exchange payload (ISSUE 12):
+                # bounded worker health/freshness digest, behind the
+                # SAME worker-token boundary as /search — the digest
+                # names datasets and fingerprints, which are data-plane
+                # metadata, not public probe output
+                self._send(200, ops_digest(engine))
             elif self.path == "/datasets":
                 # per-dataset fingerprints let the coordinator group
                 # only IDENTICAL shard copies as replicas (a worker
@@ -233,7 +241,10 @@ def _make_handler(
                 self._send(404, {"error": "not found"})
                 return
             try:
-                payload = VariantQueryPayload(**json.loads(raw))
+                t_recv = time.perf_counter()
+                # from_doc drops unknown keys: this worker must keep
+                # answering a coordinator one payload-field ahead of it
+                payload = VariantQueryPayload.from_doc(json.loads(raw))
                 # adopt the coordinator's trace id (X-Beacon-Trace) so
                 # worker-side spans parent into the same distributed
                 # trace; a direct caller without the header gets a
@@ -248,13 +259,45 @@ def _make_handler(
                     "worker.search",
                     datasets=len(payload.dataset_ids or []),
                 ):
+                    t_eng = time.perf_counter()
                     responses = engine.search(payload)
+                    engine_s = time.perf_counter() - t_eng
+                t_ser = time.perf_counter()
+                docs = [dataclasses.asdict(r) for r in responses]
+                serialize_s = time.perf_counter() - t_ser
+                # the span-summary side channel (ISSUE 12): a compact
+                # worker-stage decomposition the coordinator grafts as
+                # child spans into its own trace tree — the worker's
+                # time stops being an opaque RTT. ``queueMs`` is the
+                # micro-batch wait when the engine annotated one;
+                # ``cache`` the response-cache outcome; ``rows`` the
+                # matched rows shipped back. Bounded and additive: an
+                # old coordinator ignores the extra key.
+                notes = ctx.notes
+                try:
+                    queue_ms = float(notes.get("batch_ms") or 0.0)
+                except (TypeError, ValueError):
+                    queue_ms = 0.0
+                # the batch wait happened INSIDE engine.search: report
+                # engine time EXCLUSIVE of it so the grafted stages lay
+                # out sequentially without double-counting the queue
+                engine_excl_ms = max(engine_s * 1e3 - queue_ms, 0.0)
                 self._send(
                     200,
                     {
-                        "responses": [
-                            dataclasses.asdict(r) for r in responses
-                        ]
+                        "responses": docs,
+                        "meta": {
+                            "spanId": new_span_id(),
+                            "queueMs": round(queue_ms, 3),
+                            "engineMs": round(engine_excl_ms, 3),
+                            "serializeMs": round(serialize_s * 1e3, 3),
+                            "totalMs": round(
+                                (time.perf_counter() - t_recv) * 1e3, 3
+                            ),
+                            "rows": sum(len(r.variants) for r in responses),
+                            "cache": notes.get("response_cache", ""),
+                            "datasets": len(payload.dataset_ids or []),
+                        },
                     },
                 )
             except Exception as e:  # worker errors travel to coordinator
@@ -380,6 +423,61 @@ class WorkerServer:
         self.server.close_all_connections()
 
 
+#: datasets/fingerprints listed per digest before truncation — the
+#: digest must stay a bounded control-plane message, never a data dump
+DIGEST_DATASET_CAP = 128
+
+
+def ops_digest(engine, extras: dict | None = None) -> dict:
+    """The bounded worker-health digest served at ``/ops/digest`` (the
+    fleet-federation exchange payload, ISSUE 12): per-dataset identity
+    (the divergence signal), delta-tail depth/rows (the freshness-lag
+    signal), delta publishes, and open breakers. Every field reads
+    lock-free engine snapshots — a digest poll must answer while a
+    stack rebuild holds the publish lock. ``extras`` lets an embedded
+    coordinator add its app-tier signals (SLO breaches, slow-query
+    count, top cost tenants); a bare worker host serves the engine
+    fields alone. This is also the exchange payload ROADMAP item 4's
+    cross-coordinator quota convergence will ride."""
+    base_fp = getattr(engine, "base_fingerprint", None)
+    ds_fps_fn = getattr(engine, "dataset_fingerprints", None)
+    delta_stats = getattr(engine, "delta_stats", None)
+    delta_metrics = getattr(engine, "delta_metrics", None)
+    datasets = engine.datasets()
+    ds_fps = dict(
+        sorted((ds_fps_fn() if ds_fps_fn is not None else {}).items())[
+            :DIGEST_DATASET_CAP
+        ]
+    )
+    breakers: list[str] = []
+    breaker = getattr(engine, "breaker", None)
+    if breaker is not None:
+        breakers = sorted(
+            u
+            for u, d in breaker.metrics().items()
+            if d.get("state") != "closed"
+        )
+    doc = {
+        "time": time.time(),
+        "datasets": datasets[:DIGEST_DATASET_CAP],
+        "datasetsTotal": len(datasets),
+        "baseFingerprint": (
+            base_fp() if base_fp is not None else engine.index_fingerprint()
+        ),
+        "datasetFingerprints": ds_fps,
+        "deltaTails": delta_stats() if delta_stats is not None else {},
+        "deltaPublishes": (
+            delta_metrics().get("publishes", 0)
+            if delta_metrics is not None
+            else 0
+        ),
+        "openBreakers": breakers,
+    }
+    if extras:
+        doc.update(extras)
+    return doc
+
+
 # -- coordinator side ---------------------------------------------------------
 #
 # urllib_post / urllib_get / urllib_post_bytes live in transport.py now
@@ -437,6 +535,87 @@ def register_dispatch_metrics(registry, supplier) -> None:
         "hit rows gathered on-device by the mesh tier's row gather",
         fn=field("mesh_gather_rows"),
     )
+    # fleet federation (ISSUE 12): the digest-poll plane's own series
+    registry.counter(
+        "fleet.digest_polls",
+        "worker /ops/digest collection passes run by the fleet view",
+        fn=field("fleet_polls"),
+    )
+    registry.gauge(
+        "fleet.workers_reachable",
+        "workers whose latest digest poll answered",
+        fn=field("fleet_reachable"),
+    )
+    registry.gauge(
+        "fleet.divergent_datasets",
+        "datasets whose replicas advertise divergent fingerprints",
+        fn=field("fleet_divergent"),
+    )
+
+
+def _graft_worker_spans(wsp, url: str, meta, rtt_s: float) -> None:
+    """Adopt one worker leg's side-channel span summary (the ``meta``
+    block of a ``/search`` response) as child spans of the
+    coordinator's ``dispatch.worker_call`` span — the Dapper
+    cross-process assembly the reference's SNS fan-out never had.
+    Network time is DERIVED (RTT minus the worker-reported total,
+    split evenly around the remote span: the coordinator cannot
+    observe the skew) and the worker's queue/engine/serialize stages
+    lay out sequentially inside it. No-op when tracing is disabled
+    (``wsp`` is the null span) or the worker predates the summary."""
+    sp = getattr(wsp, "span", None)
+    if sp is None or not isinstance(meta, dict):
+        return
+    try:
+        total_ms = float(meta.get("totalMs") or 0.0)
+    except (TypeError, ValueError):
+        return
+    rtt_ms = rtt_s * 1e3
+    net_ms = max(rtt_ms - total_ms, 0.0)
+    wsp.note(
+        networkMs=round(net_ms, 3),
+        workerMs=round(total_ms, 3),
+        rows=meta.get("rows", 0),
+        cache=meta.get("cache", ""),
+    )
+    now = time.perf_counter()
+    w_start = now - rtt_s + net_ms / 2e3
+    remote = Span(
+        name="worker.remote",
+        t_start=w_start,
+        t_end=w_start + total_ms / 1e3,
+        meta={
+            "url": url,
+            "rows": meta.get("rows", 0),
+            "cache": meta.get("cache", ""),
+            "datasets": meta.get("datasets", 0),
+        },
+        trace_id=sp.trace_id,
+        span_id=str(meta.get("spanId") or new_span_id()),
+    )
+    t = w_start
+    for name, key in (
+        ("worker.queue", "queueMs"),
+        ("worker.engine", "engineMs"),
+        ("worker.serialize", "serializeMs"),
+    ):
+        try:
+            ms = float(meta.get(key) or 0.0)
+        except (TypeError, ValueError):
+            ms = 0.0
+        if ms <= 0.0:
+            continue
+        remote.children.append(
+            Span(
+                name=name,
+                t_start=t,
+                t_end=t + ms / 1e3,
+                trace_id=sp.trace_id,
+                span_id=new_span_id(),
+            )
+        )
+        t += ms / 1e3
+    sp.children.append(remote)
 
 
 def _fingerprint_freshness(fp: str) -> int:
@@ -1301,6 +1480,219 @@ class MeshDispatchTier:
         return out
 
 
+class FleetView:
+    """Fleet-wide telemetry federation (ISSUE 12): the coordinator's
+    collected view of every worker's ``/ops/digest``, served at
+    ``/fleet/status``. Digests are polled lazily at a bounded cadence —
+    a ``snapshot()`` older than ``interval_s`` refreshes inline, so an
+    unqueried fleet pays nothing and a dashboard polling every second
+    still only touches workers once per interval (the low-cadence
+    poller the rediscovery loop's shape suggested, without another
+    standing thread). Polls ride the engine's authenticated transport:
+    the digest exchange lives inside the existing worker-token
+    boundary, widening nothing.
+
+    The fleet-level ``diagnosis`` names the **stalest replica** (most
+    fingerprint-losing dataset copies by the freshness heuristic, else
+    the deepest standing delta tail), the **hottest worker** (highest
+    median RTT from the router's own measurements), the **divergent
+    datasets** (replicas advertising different copies), and the
+    unreachable workers — the federated signal layer ROADMAP items 4
+    (quota convergence) and 5 (live migration) ride on.
+    """
+
+    #: per-digest GET budget: a digest is a small control message and
+    #: must never inherit the minutes-long search timeout
+    DIGEST_TIMEOUT_S = 5.0
+
+    def __init__(self, engine, *, interval_s: float = 10.0,
+                 clock=time.monotonic):
+        self.engine = engine
+        self.interval_s = max(0.5, float(interval_s))
+        self._clock = clock
+        self._lock = threading.Lock()
+        # single-flight refresh: concurrent stale snapshot() calls must
+        # not each run a full worker sweep (non-blocking acquire — the
+        # loser serves the cached view the winner is refreshing)
+        self._poll_lock = threading.Lock()
+        # url -> {"digest": dict|None, "error": str|None, "tMono": t}
+        self._digests: dict[str, dict] = {}
+        self._polls = 0
+        self._last_poll: float | None = None
+
+    def _poll_one(self, url: str) -> tuple[str, dict, bool]:
+        t = self._clock()
+        try:
+            status, doc = self.engine._get_auth(
+                f"{url}/ops/digest",
+                min(self.DIGEST_TIMEOUT_S, self.engine.timeout_s),
+            )
+        except Exception as e:
+            return (
+                url,
+                {
+                    "digest": None,
+                    "error": f"{type(e).__name__}: {e}",
+                    "tMono": t,
+                },
+                False,
+            )
+        if status == 200 and isinstance(doc, dict):
+            return url, {"digest": doc, "error": None, "tMono": t}, True
+        return (
+            url,
+            {"digest": None, "error": f"http {status}", "tMono": t},
+            False,
+        )
+
+    def poll(self) -> int:
+        """One collection pass over every configured worker; returns
+        how many answered. Workers are swept CONCURRENTLY so the pass
+        is bounded by one digest timeout, not N of them — /fleet/status
+        bypasses admission and deadlines, so an inline refresh stalling
+        ~5 s per dead worker sequentially would be exactly the probe
+        hang the bypass exists to avoid. Failures are recorded per
+        worker (an unreachable worker is a fleet-status FINDING, not an
+        error)."""
+        urls = list(self.engine.worker_urls)
+        ok = 0
+        if urls:
+            with ThreadPoolExecutor(
+                min(8, len(urls)), thread_name_prefix="fleet-digest"
+            ) as pool:
+                results = list(pool.map(self._poll_one, urls))
+            with self._lock:
+                for url, entry, answered in results:
+                    self._digests[url] = entry
+                    ok += int(answered)
+        with self._lock:
+            self._polls += 1
+            self._last_poll = self._clock()
+            for u in list(self._digests):
+                if u not in urls:  # decommissioned mid-flight
+                    del self._digests[u]
+        return ok
+
+    def _divergence(self, rows: dict) -> tuple[dict, dict]:
+        """({dataset: {url: fp}} for divergent datasets,
+        {url: stale-copy count}) over the cached digests."""
+        by_ds: dict[str, dict[str, str]] = {}
+        for url, e in rows.items():
+            d = e.get("digest")
+            if not d:
+                continue
+            for ds, fp in (d.get("datasetFingerprints") or {}).items():
+                by_ds.setdefault(ds, {})[url] = fp
+        divergent: dict[str, dict[str, str]] = {}
+        stale_counts: dict[str, int] = {}
+        for ds, fps in sorted(by_ds.items()):
+            if len(set(fps.values())) <= 1:
+                continue
+            divergent[ds] = dict(sorted(fps.items()))
+            win = max(
+                fps.values(),
+                key=lambda fp: (_fingerprint_freshness(fp), fp),
+            )
+            for url, fp in fps.items():
+                if fp != win:
+                    stale_counts[url] = stale_counts.get(url, 0) + 1
+        return divergent, stale_counts
+
+    def stats(self) -> dict:
+        """The ``fleet.*`` metric values — cached state only, a
+        /metrics scrape must never trigger worker network IO."""
+        with self._lock:
+            rows = {u: dict(e) for u, e in self._digests.items()}
+            polls = self._polls
+        divergent, _stale = self._divergence(rows)
+        return {
+            "polls": polls,
+            "reachable": sum(
+                1 for e in rows.values() if e.get("digest") is not None
+            ),
+            "divergent": len(divergent),
+        }
+
+    def snapshot(self) -> dict:
+        """The ``/fleet/status`` document (refreshes inline when the
+        cached digests are older than ``interval_s``)."""
+        with self._lock:
+            last = self._last_poll
+        if last is None or self._clock() - last >= self.interval_s:
+            # single-flight: only one caller refreshes; a concurrent
+            # snapshot serves the cached view instead of doubling the
+            # worker sweep
+            if self._poll_lock.acquire(blocking=False):
+                try:
+                    self.poll()
+                except Exception:  # a broken poll must not 500 status
+                    log.exception("fleet digest poll failed")
+                finally:
+                    self._poll_lock.release()
+        with self._lock:
+            rows = {u: dict(e) for u, e in self._digests.items()}
+            polls = self._polls
+            last = self._last_poll
+        now = self._clock()
+        divergent, stale_counts = self._divergence(rows)
+        workers: dict[str, dict] = {}
+        tail_rows: dict[str, int] = {}
+        for url in sorted(rows):
+            e = rows[url]
+            d = e.get("digest")
+            w: dict = {
+                "reachable": d is not None,
+                "ageS": round(now - e["tMono"], 1),
+                "medianRttMs": self.engine.router.median_rtt_ms(url),
+                "staleDatasets": stale_counts.get(url, 0),
+            }
+            if d is not None:
+                w["digest"] = d
+                w["deltaTailRows"] = sum(
+                    int(t.get("rows", 0))
+                    for t in (d.get("deltaTails") or {}).values()
+                )
+                tail_rows[url] = w["deltaTailRows"]
+            else:
+                w["error"] = e.get("error")
+            workers[url] = w
+        # stalest replica: fingerprint-divergence losers first (the
+        # replica serving outdated copies), else the deepest standing
+        # delta tail (furthest behind its own compaction)
+        stalest = None
+        if stale_counts:
+            stalest = max(
+                sorted(stale_counts), key=lambda u: stale_counts[u]
+            )
+        elif any(tail_rows.values()):
+            stalest = max(sorted(tail_rows), key=lambda u: tail_rows[u])
+        rtts = {
+            u: w["medianRttMs"]
+            for u, w in workers.items()
+            if w.get("medianRttMs") is not None
+        }
+        return {
+            "intervalS": self.interval_s,
+            "polls": polls,
+            "lastPollAgeS": (
+                None if last is None else round(now - last, 1)
+            ),
+            "workers": workers,
+            "diagnosis": {
+                "stalestReplica": stalest,
+                "hottestWorker": (
+                    max(sorted(rtts), key=lambda u: rtts[u])
+                    if rtts
+                    else None
+                ),
+                "divergentDatasets": divergent,
+                "unreachableWorkers": sorted(
+                    u for u, w in workers.items() if not w["reachable"]
+                ),
+            },
+        }
+
+
 class DistributedEngine:
     """Coordinator: VariantEngine interface over remote workers (+ an
     optional local engine for locally-resident shards).
@@ -1442,6 +1834,15 @@ class DistributedEngine:
                 min_shards=getattr(eng_cfg, "mesh_min_shards", 2),
                 axis=getattr(eng_cfg, "mesh_axis", "d"),
             )
+        # fleet telemetry federation (ISSUE 12): worker /ops/digest
+        # collection + the /fleet/status rollup. Construction is free —
+        # digests are only polled when the view is read (lazily, at
+        # most once per interval).
+        obs_cfg = getattr(self.config, "observability", None)
+        self.fleet = FleetView(
+            self,
+            interval_s=getattr(obs_cfg, "fleet_digest_interval_s", 10.0),
+        )
 
     # headers are passed only when there is something to carry (a
     # configured token, an ambient trace id) AND the transport's
@@ -1507,6 +1908,7 @@ class DistributedEngine:
         mesh = (
             self.mesh_tier.stats() if self.mesh_tier is not None else {}
         )
+        fleet = self.fleet.stats()
         with self._sc_lock:
             return {
                 "short_circuits": self._short_circuits,
@@ -1517,6 +1919,9 @@ class DistributedEngine:
                 "mesh_dispatches": mesh.get("dispatches", 0),
                 "mesh_fallbacks": mesh.get("fallbacks", 0),
                 "mesh_gather_rows": mesh.get("gather_rows", 0),
+                "fleet_polls": fleet.get("polls", 0),
+                "fleet_reachable": fleet.get("reachable", 0),
+                "fleet_divergent": fleet.get("divergent", 0),
             }
 
     def route_table_age_s(self) -> float | None:
@@ -1800,8 +2205,23 @@ class DistributedEngine:
         with request_context(ctx if ctx is not None else current_context()):
             return self._call_worker_traced(url, payload, deadline)
 
+    def call_replica(
+        self, url: str, payload: VariantQueryPayload
+    ) -> list[VariantSearchResponse]:
+        """One direct ``/search`` against a SPECIFIC replica — no
+        failover, no hedging, no routing. The canary prober's
+        per-replica probe seam (canary.py): the whole point is to
+        exercise exactly one copy and judge its answer, which the
+        routed paths' fault tolerance would mask. Probe RTTs do NOT
+        feed the router's rings: sub-millisecond boolean probes would
+        otherwise dominate the p2c comparison and drag the adaptive
+        hedge p95 to probe scale on an idle fleet — every real query
+        would then hedge immediately when traffic resumes."""
+        return self._call_worker_traced(url, payload, note_rtt=False)
+
     def _call_worker_traced(
-        self, url: str, payload: VariantQueryPayload, deadline=None
+        self, url: str, payload: VariantQueryPayload, deadline=None,
+        *, note_rtt: bool = True,
     ):
         if not self.breaker.allow(url):
             # fast-fail: the route failed repeatedly and its reset
@@ -1825,37 +2245,48 @@ class DistributedEngine:
         if deadline is None:
             deadline = current_deadline()
         last = None
-        for attempt in range(self.retries + 1):
-            timeout_s = deadline.clamp(self.timeout_s)
-            if timeout_s is not None and timeout_s <= 0:
-                deadline.check(f"worker {url} call")
-            t0 = time.perf_counter()
-            try:
-                fault_point("worker.http", url)
-                status, out = self._post_auth(
-                    f"{url}/search", doc, timeout_s
-                )
-            except Exception as e:
-                last = WorkerError(f"{url}: {e}")
-            else:
-                if status == 200:
-                    # successful RTTs feed the router's p2c comparison
-                    # and the adaptive replica-hedge delay — and the
-                    # request's cost vector: the worker was occupied
-                    # that long on this request's behalf (ISSUE 11)
-                    rtt_s = time.perf_counter() - t0
-                    self.router.note_rtt(url, rtt_s)
-                    charge_cost(worker_rtt_ms=rtt_s * 1e3)
-                    self.breaker.record_success(url)
-                    return [
-                        VariantSearchResponse(**r)
-                        for r in out.get("responses", [])
-                    ]
-                last = WorkerError(
-                    f"{url}: http {status}: {out.get('error')}"
-                )
-            if attempt < self.retries:  # no dead sleep after final try
-                time.sleep(min(0.05 * (attempt + 1), 1.0))
+        # one span per worker leg (its own root tree on this pool
+        # thread, tied to the request by trace id): on success the
+        # worker's side-channel span summary grafts in as child spans,
+        # so /_trace?trace_id= shows the coordinator->worker waterfall
+        # with network time separated from worker-stage time
+        with span("dispatch.worker_call", url=url) as wsp:
+            for attempt in range(self.retries + 1):
+                timeout_s = deadline.clamp(self.timeout_s)
+                if timeout_s is not None and timeout_s <= 0:
+                    deadline.check(f"worker {url} call")
+                t0 = time.perf_counter()
+                try:
+                    fault_point("worker.http", url)
+                    status, out = self._post_auth(
+                        f"{url}/search", doc, timeout_s
+                    )
+                except Exception as e:
+                    last = WorkerError(f"{url}: {e}")
+                else:
+                    if status == 200:
+                        # successful RTTs feed the router's p2c
+                        # comparison and the adaptive replica-hedge
+                        # delay — and the request's cost vector: the
+                        # worker was occupied that long on this
+                        # request's behalf (ISSUE 11)
+                        rtt_s = time.perf_counter() - t0
+                        if note_rtt:
+                            self.router.note_rtt(url, rtt_s)
+                        charge_cost(worker_rtt_ms=rtt_s * 1e3)
+                        self.breaker.record_success(url)
+                        _graft_worker_spans(
+                            wsp, url, out.get("meta"), rtt_s
+                        )
+                        return [
+                            VariantSearchResponse(**r)
+                            for r in out.get("responses", [])
+                        ]
+                    last = WorkerError(
+                        f"{url}: http {status}: {out.get('error')}"
+                    )
+                if attempt < self.retries:  # no dead sleep after final try
+                    time.sleep(min(0.05 * (attempt + 1), 1.0))
         if deadline.expired():
             # the REQUEST ran out of time, not the worker out of
             # health: a deadline-clamped timeout must not count against

@@ -43,6 +43,11 @@ class VariantQueryPayload:
     sample_names: dict[str, list[str]] = field(default_factory=dict)
     # restrict to these samples per dataset (selected-samples path)
     selected_samples_only: bool = False
+    # bypass the response cache (ISSUE 12): known-answer canary probes
+    # must observe the LIVE data plane — a warm cached answer would
+    # mask exactly the silent corruption they exist to catch. Normal
+    # traffic never sets this.
+    no_response_cache: bool = False
     query_id: str = "TEST"
 
     @property
@@ -51,11 +56,38 @@ class VariantQueryPayload:
         return self.include_datasets in ("HIT", "ALL")
 
     def dumps(self) -> str:
-        return json.dumps(dataclasses.asdict(self))
+        d = dataclasses.asdict(self)
+        # wire compat: the probe-only flag rides the wire ONLY when set
+        # — a default-False field in every /search body would break a
+        # not-yet-upgraded worker mid rolling deploy (its constructor
+        # rejects unknown keywords)
+        if not d.get("no_response_cache"):
+            d.pop("no_response_cache", None)
+        return json.dumps(d)
+
+    @staticmethod
+    def from_doc(doc: dict) -> "VariantQueryPayload":
+        """Build from a wire dict, DROPPING unknown keys: a worker must
+        keep answering coordinators one payload-field ahead of it (the
+        forward half of the rolling-deploy contract; ``dumps`` omitting
+        default-valued new fields is the backward half). A non-empty
+        doc with NO known field at all is malformed, not newer — it
+        still raises, so garbage POSTs keep surfacing as worker errors
+        instead of parsing into an empty default query."""
+        known = {
+            f.name for f in dataclasses.fields(VariantQueryPayload)
+        }
+        kept = {k: v for k, v in doc.items() if k in known}
+        if doc and not kept:
+            raise ValueError(
+                "payload has no known fields: "
+                + ", ".join(sorted(doc))
+            )
+        return VariantQueryPayload(**kept)
 
     @staticmethod
     def loads(s: str) -> "VariantQueryPayload":
-        return VariantQueryPayload(**json.loads(s))
+        return VariantQueryPayload.from_doc(json.loads(s))
 
 
 @dataclass

@@ -40,12 +40,70 @@ import time
 #: (name, seconds) — fast and slow burn windows, in rendering order
 WINDOWS: tuple[tuple[str, float], ...] = (("5m", 300.0), ("1h", 3600.0))
 
+#: THE single literal source of the probe/diagnostic route surface
+#: (ISSUE 12 satellite). Three request-path lists used to hand-maintain
+#: their own copies of "what is a probe" — the SLO budget exclusion
+#: here, the API layer's auth/admission bypass set, and the
+#: request-latency histogram's named diagnostic labels — and drift
+#: between them silently folded probe traffic into error budgets.
+#: Everything now DERIVES from this set (``tools/check_probe_routes.py``
+#: enforces it statically, tier-1 via tests/test_telemetry.py):
+#: single-segment entries are route labels AND paths; dotted entries
+#: are the two-segment diagnostic surfaces (``ops.events`` =
+#: ``/ops/events``); ``canary`` is the prober's synthetic in-process
+#: route (sbeacon_tpu/canary.py) — excluded from budgets and cost
+#: tables like every probe, though it never arrives over HTTP.
+PROBE_ROUTE_LABELS = frozenset({
+    "health",
+    "ready",
+    "metrics",
+    "slo",
+    "_trace",
+    "canary",
+    "ops.events",
+    "ops.costs",
+    "debug.status",
+    "fleet.status",
+})
+
+#: probe labels that are NOT auth/admission-bypass transport paths:
+#: ``/_trace`` can render large span trees so it stays behind the
+#: admission gate, and ``canary`` is never an HTTP path at all
+NON_PATH_PROBE_LABELS = frozenset({"_trace", "canary"})
+
+#: probe labels with no HTTP path at all (the prober's synthetic
+#: in-process route) — everything else appears in the API route table
+NON_HTTP_PROBE_LABELS = frozenset({"canary"})
+
+#: the API layer's bypass set (served before auth/admission/deadlines)
+PROBE_BYPASS_PATHS = frozenset(
+    label.replace(".", "/")
+    for label in PROBE_ROUTE_LABELS - NON_PATH_PROBE_LABELS
+)
+
+#: single-segment probe labels that ARE HTTP route heads (the latency
+#: histogram's bounded head set derives its probe members from this)
+PROBE_HEAD_LABELS = frozenset(
+    label
+    for label in PROBE_ROUTE_LABELS - NON_HTTP_PROBE_LABELS
+    if "." not in label
+)
+
+#: the two-segment diagnostic surfaces the latency histogram may mint
+#: named route labels for (anything else under their heads collapses
+#: to "other" so a URL scanner cannot mint series)
+DIAGNOSTIC_ROUTE_LABELS = frozenset(
+    label for label in PROBE_ROUTE_LABELS if "." in label
+)
+
 #: probe/diagnostic routes never carry objectives: scrapes and status
 #: queries must not consume (or fabricate) anyone's error budget
 EXCLUDED_ROUTES = frozenset(
-    {"health", "ready", "metrics", "slo", "_trace"}
+    label for label in PROBE_ROUTE_LABELS if "." not in label
 )
-_EXCLUDED_HEADS = ("ops", "debug")
+_EXCLUDED_HEADS = tuple(
+    sorted({label.split(".", 1)[0] for label in DIAGNOSTIC_ROUTE_LABELS})
+)
 
 
 @dataclasses.dataclass(frozen=True)

@@ -874,16 +874,13 @@ class EventJournal:
             self._ring.append(evt)
         return evt["seq"]
 
-    def events(self, *, since: int = 0, kind: str = "",
-               limit: int = 256) -> list[dict]:
-        """Events with seq > ``since``, newest last, optionally
-        filtered by kind, capped at the most recent ``limit``.
-
-        ``kind`` is a COMMA-SEPARATED list of filters, each matching
-        exactly or by prefix (``breaker`` matches ``breaker.open``) —
-        so an operator correlating two control planes
-        (``?kind=compaction,shaping.brownout``) tails ONE interleaved
-        stream instead of merging two polls by hand."""
+    @staticmethod
+    def _kind_matcher(kind: str):
+        """``kind`` is a COMMA-SEPARATED list of filters, each
+        matching exactly or by prefix (``breaker`` matches
+        ``breaker.open``) — one parser for BOTH the newest-capped and
+        the paginated read paths, so their filter semantics can never
+        diverge."""
         kinds = [k.strip() for k in kind.split(",") if k.strip()]
 
         def _match(k: str) -> bool:
@@ -891,6 +888,15 @@ class EventJournal:
                 k == want or k.startswith(want + ".") for want in kinds
             )
 
+        return _match
+
+    def events(self, *, since: int = 0, kind: str = "",
+               limit: int = 256) -> list[dict]:
+        """Events with seq > ``since``, newest last, optionally
+        filtered by kind (comma-separated exact-or-prefix list — an
+        operator correlating two control planes tails ONE interleaved
+        stream), capped at the most recent ``limit``."""
+        _match = self._kind_matcher(kind)
         with self._lock:
             evs = [
                 dict(e)
@@ -899,6 +905,43 @@ class EventJournal:
             ]
         limit = int(limit)
         return evs[-limit:] if limit > 0 else []
+
+    def events_page(
+        self, *, since: int = 0, kind: str = "", limit: int = 256
+    ) -> tuple[list[dict], int]:
+        """Forward pagination for tailing clients (ISSUE 12 satellite):
+        the OLDEST ``limit`` matching events with seq > ``since`` plus a
+        ``nextSince`` cursor — pass it back as ``since`` to resume with
+        no re-reads and no silently skipped middle (the newest-capped
+        :meth:`events` drops a burst's middle entries, so a tailer had
+        to guess the next monotonic stamp). When the page is truncated
+        the cursor is the last returned seq (more pages follow); when
+        the caller is caught up it jumps to the journal head, so
+        filtered tails skip non-matching events instead of rescanning
+        them every poll. Entries that rolled off the ring during the
+        client's gap are gone either way — ``published()`` vs the count
+        consumed detects that loss."""
+        _match = self._kind_matcher(kind)
+        limit = int(limit)
+        if limit <= 0:
+            return [], int(since)
+        page: list[dict] = []
+        truncated = False
+        with self._lock:
+            # stop at limit+1 matches: a far-behind tailer must cost a
+            # page's worth of copies under the lock, not a full-ring
+            # copy discarded down to `limit` (publish_event contends
+            # on this lock from control-plane hot paths)
+            for e in self._ring:
+                if e["seq"] > since and _match(e["kind"]):
+                    if len(page) == limit:
+                        truncated = True
+                        break
+                    page.append(dict(e))
+            head = self._seq
+        if truncated:  # resume right after this page
+            return page, page[-1]["seq"]
+        return page, max(int(since), head)
 
     def last_seq(self) -> int:
         with self._lock:

@@ -122,6 +122,11 @@ class Tracer:
         # name -> [count, total, min, max]
         self.stats: dict[str, list[float]] = {}
         self.trees: list[Span] = []
+        # trace_id -> [root Span, ...] over the SAME retained trees:
+        # /_trace?trace_id= resolves in O(trees-for-id) instead of
+        # re-serialising and filtering the whole ring per lookup
+        # (exemplar-to-trace resolution is a per-dashboard-click path)
+        self._by_trace: dict[str, list[Span]] = {}
 
     # -- gating -------------------------------------------------------------
 
@@ -204,8 +209,21 @@ class Tracer:
                 st[3] = max(st[3], el)
             if was_root:  # a completed root tree
                 self.trees.append(sp)
+                if sp.trace_id:
+                    self._by_trace.setdefault(sp.trace_id, []).append(sp)
                 if len(self.trees) > self._keep_trees:
+                    evicted = self.trees[: -self._keep_trees]
                     del self.trees[: -self._keep_trees]
+                    for old in evicted:
+                        bucket = self._by_trace.get(old.trace_id)
+                        if bucket is None:
+                            continue
+                        try:
+                            bucket.remove(old)
+                        except ValueError:
+                            pass
+                        if not bucket:
+                            del self._by_trace[old.trace_id]
 
     def wrap(self, name: str | None = None):
         """Decorator form: ``@tracer.wrap("kernel.run")``."""
@@ -228,17 +246,20 @@ class Tracer:
         with self._lock:
             self.stats.clear()
             self.trees.clear()
+            self._by_trace.clear()
 
     def recent_trees(self, trace_id: str | None = None) -> list[dict]:
         """The retained complete span trees as JSON-ready dicts (the
         /_trace payload), newest last; ``trace_id`` filters to one
-        distributed request's spans."""
+        distributed request's spans via the maintained per-trace index
+        — O(matching trees), not a serialise-and-scan of the whole
+        ring (the exemplar-click resolution path)."""
         with self._lock:
-            trees = list(self.trees)
-        out = [t.to_dict() for t in trees]
-        if trace_id is not None:
-            out = [t for t in out if t["traceId"] == trace_id]
-        return out
+            if trace_id is not None:
+                trees = list(self._by_trace.get(trace_id, ()))
+            else:
+                trees = list(self.trees)
+        return [t.to_dict() for t in trees]
 
     def report(self) -> str:
         """Aggregate table + the most recent span tree."""

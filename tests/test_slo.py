@@ -301,8 +301,12 @@ def test_ops_events_endpoint_schema(app):
     publish_event("dispatch.failover", failed="http://w1:1", to="http://w2:1")
     status, doc = app.handle("GET", "/ops/events", {"since": str(seq0)})
     assert status == 200
-    assert set(doc) == {"events", "lastSeq", "published", "enabled"}
+    assert set(doc) == {
+        "events", "nextSince", "lastSeq", "published", "enabled",
+    }
     assert doc["lastSeq"] >= seq0 + 2
+    # caught up: the resume cursor jumps to the journal head
+    assert doc["nextSince"] == doc["lastSeq"]
     kinds = [e["kind"] for e in doc["events"]]
     assert "breaker.open" in kinds and "dispatch.failover" in kinds
     for e in doc["events"]:
@@ -325,8 +329,11 @@ def test_debug_status_schema_and_diagnosis(app):
     assert status == 200
     assert set(doc) == {
         "ready", "beaconId", "slo", "breakers", "routing", "queues",
-        "ingest", "stages", "costs", "events", "diagnosis",
+        "ingest", "stages", "costs", "canary", "events", "diagnosis",
     }
+    # canary rollup (ISSUE 12): the prober exists (idle) on every app
+    assert doc["canary"]["registeredProbes"] == 0
+    assert doc["canary"]["mismatches"] == 0
     # ingest-while-serving rollup (ISSUE 10): delta-tail depth +
     # compactor counters; empty tails render as {}
     assert set(doc["ingest"]) <= {"deltaTails", "compactor"}
@@ -344,7 +351,7 @@ def test_debug_status_schema_and_diagnosis(app):
     assert "costliestTenant" in doc["costs"]
     assert set(doc["diagnosis"]) == {
         "breachedSlos", "openBreakers", "slowestStage", "slowestWorker",
-        "costliestTenant", "costliestShape",
+        "costliestTenant", "costliestShape", "canaryMismatches",
     }
     assert set(doc["events"]) == {"lastSeq", "published"}
     # single-host app: no worker routing section content

@@ -224,6 +224,13 @@ GOLDEN_METRICS = [
     "cost.response_bytes",
     "cost.shape_units",
     "telemetry.label_overflow",
+    "fleet.digest_polls",
+    "fleet.workers_reachable",
+    "fleet.divergent_datasets",
+    "canary.probes",
+    "canary.mismatches",
+    "canary.failures",
+    "canary.slow_probes",
 ]
 
 
@@ -596,6 +603,61 @@ def test_annotation_key_lint():
         timeout=60,
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# -- probe-route lint (ISSUE 12 satellite) -------------------------------------
+
+
+@obs
+def test_probe_route_lint():
+    """The SLO budget exclusion, the API probe-bypass path set, and
+    the latency route-label set must all derive from the ONE literal
+    source (slo.PROBE_ROUTE_LABELS) — static derivation checks in the
+    subprocess, behavioural two-way parity in-process."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_probe_routes.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_probe_routes import runtime_parity
+    finally:
+        sys.path.pop(0)
+    errors = runtime_parity()
+    assert not errors, errors
+
+
+@obs
+def test_probe_route_lint_catches_violations(tmp_path):
+    """A hand-maintained probe list in app.py must fail the lint."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_probe_routes import lint_app, lint_source
+    finally:
+        sys.path.pop(0)
+
+    errors, labels, non_path = lint_source()
+    assert not errors and labels and non_path <= labels
+    # simulate the drift: a literal tuple of probe paths in app code
+    import check_probe_routes as cpr
+
+    bad = tmp_path / "app.py"
+    bad.write_text(
+        'PROBES = ("health", "ops/events")\n'
+        "def handle(self, route):\n"
+        "    return route in PROBES\n"
+    )
+    orig = cpr.APP_PY
+    cpr.APP_PY = bad
+    try:
+        errs = cpr.lint_app(labels)
+    finally:
+        cpr.APP_PY = orig
+    assert any("collection literal" in e for e in errs)
 
 
 @obs

@@ -194,6 +194,56 @@ def test_legacy_3arg_transport_survives_ambient_context():
         dist.close()
 
 
+def _find_spans(trees, name):
+    out = []
+    for tree in trees:
+        stack = [tree]
+        while stack:
+            node = stack.pop()
+            if node["name"] == name:
+                out.append(node)
+            stack.extend(node["children"])
+    return out
+
+
+@obs
+def test_worker_child_spans_graft_into_coordinator_trace(fanout_app):
+    """Cross-process trace assembly (ISSUE 12): over REAL HTTP, each
+    worker leg's span summary (response-meta side channel) grafts into
+    the coordinator's tracer as child spans of dispatch.worker_call —
+    /_trace?trace_id= shows one waterfall with worker-stage timings
+    and the derived network time, without relying on the workers
+    sharing the coordinator's process-global tracer."""
+    app = fanout_app
+    want = new_trace_id()
+    status, body = app.handle(
+        "POST",
+        "/g_variants",
+        body=_query_body(),
+        headers={TRACE_HEADER: want},
+    )
+    assert status == 200, body
+    status, out = app.handle("GET", "/_trace", {"trace_id": want})
+    assert status == 200
+    calls = _find_spans(out["traces"], "dispatch.worker_call")
+    assert calls, "no dispatch.worker_call span in the filtered trace"
+    remotes = _find_spans(calls, "worker.remote")
+    assert remotes, "worker span summary did not graft as child spans"
+    for remote in remotes:
+        assert remote["traceId"] == want
+        # the grafted children carry the worker's stage decomposition
+        child_names = {c["name"] for c in remote["children"]}
+        assert "worker.engine" in child_names
+        assert remote["meta"].get("rows") is not None
+    # network time is derived on the wrapping call span: RTT minus the
+    # worker-reported total, both recorded as span meta
+    for call in calls:
+        if any(c["name"] == "worker.remote" for c in call["children"]):
+            assert "networkMs" in call["meta"]
+            assert "workerMs" in call["meta"]
+            assert call["meta"]["networkMs"] >= 0
+
+
 @obs
 def test_fanout_without_inbound_header_mints_one_id(fanout_app):
     app = fanout_app

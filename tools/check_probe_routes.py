@@ -1,0 +1,242 @@
+#!/usr/bin/env python
+"""Static probe-route parity lint (ISSUE 12 satellite).
+
+Three request-path lists must agree on "what is a probe": the SLO
+budget exclusion (``slo.EXCLUDED_ROUTES`` + ``_EXCLUDED_HEADS``), the
+API layer's auth/admission bypass path set, and the request-latency
+histogram's named diagnostic route labels. The cost-accounting fold
+additionally gates on the same predicate (``slo.tracked``). They used
+to be three hand-maintained literals, and drift silently folded probe
+traffic into error budgets and tenant cost tables.
+
+They now all DERIVE from one literal source,
+``sbeacon_tpu/slo.py PROBE_ROUTE_LABELS``, and this lint keeps it that
+way:
+
+- the source set must be a pure literal of valid route labels (an
+  f-string or computed member cannot be audited statically);
+- ``NON_PATH_PROBE_LABELS`` must be a literal subset of it;
+- every derived set in slo.py (``EXCLUDED_ROUTES``,
+  ``_EXCLUDED_HEADS``, ``PROBE_BYPASS_PATHS``,
+  ``DIAGNOSTIC_ROUTE_LABELS``) must reference the source by name, not
+  re-declare a literal;
+- ``api/app.py`` must not hold ANY collection literal containing a
+  probe route string (a re-introduced hand list is exactly the drift),
+  and its cost fold must gate on ``slo.tracked``.
+
+:func:`runtime_parity` adds the two-way behavioural check (every probe
+label budget-excluded; every bypass path labelled back to its own
+label; unknown diagnostic paths collapsing to ``other``) — the tier-1
+test calls it in-process (``tests/test_telemetry.py``) so the
+subprocess run stays import-free and fast, like the metric-name lint.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+SLO_PY = PKG / "slo.py"
+APP_PY = PKG / "api" / "app.py"
+
+#: the grammar of one probe route label: a bounded route label
+#: (optionally ``head.sub`` for the two-segment diagnostic surfaces)
+LABEL = re.compile(r"^_?[a-z][a-z0-9_]*(\.[a-z][a-z0-9_]*)?$")
+
+#: derived sets in slo.py that must reference the source by name
+DERIVED = (
+    "PROBE_BYPASS_PATHS",
+    "PROBE_HEAD_LABELS",
+    "DIAGNOSTIC_ROUTE_LABELS",
+    "EXCLUDED_ROUTES",
+    "_EXCLUDED_HEADS",
+)
+
+
+def _literal_str_set(node: ast.AST) -> set[str] | None:
+    """The string set of a ``frozenset({...})`` / set / tuple literal
+    of plain strings, or None when any member is computed."""
+    if (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Name)
+        and node.func.id in ("frozenset", "set", "tuple")
+        and len(node.args) == 1
+    ):
+        node = node.args[0]
+    if not isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+        return None
+    out = set()
+    for elt in node.elts:
+        if not (isinstance(elt, ast.Constant) and isinstance(elt.value, str)):
+            return None
+        out.add(elt.value)
+    return out
+
+
+def _names_in(node: ast.AST) -> set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _assignments(tree: ast.AST) -> dict[str, ast.AST]:
+    out: dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value
+    return out
+
+
+def lint_source() -> tuple[list[str], set[str], set[str]]:
+    """Errors + (labels, non-path labels) parsed from slo.py."""
+    errors: list[str] = []
+    tree = ast.parse(SLO_PY.read_text())
+    assigns = _assignments(tree)
+    src = assigns.get("PROBE_ROUTE_LABELS")
+    labels: set[str] = set()
+    if src is None:
+        errors.append("slo.py: PROBE_ROUTE_LABELS not found")
+    else:
+        got = _literal_str_set(src)
+        if got is None:
+            errors.append(
+                "slo.py: PROBE_ROUTE_LABELS must be a pure string "
+                "literal set (computed members cannot be audited)"
+            )
+        else:
+            labels = got
+            for label in sorted(labels):
+                if not LABEL.match(label):
+                    errors.append(
+                        f"slo.py: invalid probe route label {label!r}"
+                    )
+    non_path: set[str] = set()
+    np_node = assigns.get("NON_PATH_PROBE_LABELS")
+    if np_node is None:
+        errors.append("slo.py: NON_PATH_PROBE_LABELS not found")
+    else:
+        got = _literal_str_set(np_node)
+        if got is None:
+            errors.append(
+                "slo.py: NON_PATH_PROBE_LABELS must be a pure literal"
+            )
+        else:
+            non_path = got
+            if labels and not non_path <= labels:
+                errors.append(
+                    "slo.py: NON_PATH_PROBE_LABELS must be a subset "
+                    f"of PROBE_ROUTE_LABELS (extra: "
+                    f"{sorted(non_path - labels)})"
+                )
+    for name in DERIVED:
+        node = assigns.get(name)
+        if node is None:
+            errors.append(f"slo.py: derived set {name} not found")
+            continue
+        refs = _names_in(node)
+        if not refs & {"PROBE_ROUTE_LABELS", "DIAGNOSTIC_ROUTE_LABELS"}:
+            errors.append(
+                f"slo.py: {name} must derive from PROBE_ROUTE_LABELS "
+                "(a re-declared literal is exactly the drift this "
+                "lint exists to stop)"
+            )
+    return errors, labels, non_path
+
+
+def lint_app(labels: set[str]) -> list[str]:
+    """api/app.py must not re-grow a hand-maintained probe list."""
+    errors: list[str] = []
+    src = APP_PY.read_text()
+    tree = ast.parse(src)
+    probe_strings = set(labels) | {
+        label.replace(".", "/") for label in labels
+    }
+    # Set/List/Tuple displays only: a hand-maintained probe LIST is
+    # the drift this catches; response-document dict keys that happen
+    # to reuse a label word ("ready", "slo") are not route lists
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Set, ast.List, ast.Tuple)):
+            hits = sorted(
+                e.value
+                for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)
+                and e.value in probe_strings
+            )
+            if hits:
+                errors.append(
+                    f"api/app.py:{node.lineno}: collection literal "
+                    f"contains probe route string(s) {hits} — derive "
+                    "from slo.PROBE_ROUTE_LABELS instead"
+                )
+    if ".slo.tracked(" not in src:
+        errors.append(
+            "api/app.py: the cost-accounting fold no longer gates on "
+            "slo.tracked — the tracked-route exclusion must share the "
+            "probe-route source"
+        )
+    for want in ("PROBE_BYPASS_PATHS", "DIAGNOSTIC_ROUTE_LABELS"):
+        if want not in src:
+            errors.append(
+                f"api/app.py: no reference to slo.{want} — the bypass/"
+                "label sets must derive from the shared source"
+            )
+    return errors
+
+
+def runtime_parity() -> list[str]:
+    """Two-way behavioural parity, checked in-process (the tier-1 test
+    calls this where sbeacon_tpu is already imported)."""
+    from sbeacon_tpu import slo as slo_mod
+    from sbeacon_tpu.api.app import BeaconApp
+
+    errors: list[str] = []
+    shim = object.__new__(BeaconApp)
+    for label in sorted(slo_mod.PROBE_ROUTE_LABELS):
+        if slo_mod.SloEngine.tracked(label):
+            errors.append(
+                f"probe label {label!r} is NOT excluded from SLO "
+                "budgets (slo.tracked returned True)"
+            )
+    for route in ("info", "g_variants", "submit", "datasets.id"):
+        if not slo_mod.SloEngine.tracked(route):
+            errors.append(f"real route {route!r} wrongly excluded")
+    bypass = slo_mod.PROBE_ROUTE_LABELS - slo_mod.NON_PATH_PROBE_LABELS
+    for label in sorted(bypass):
+        path = "/" + label.replace(".", "/")
+        got = BeaconApp._route_label(shim, path)
+        if got != label:
+            errors.append(
+                f"route label for probe path {path!r} is {got!r}, "
+                f"want {label!r} — the latency histogram would mint a "
+                "divergent series for this probe"
+            )
+    for junk in ("/ops/whatever", "/debug/whatever", "/fleet/whatever"):
+        got = BeaconApp._route_label(shim, junk)
+        if got != "other":
+            errors.append(
+                f"unknown diagnostic path {junk!r} labels as {got!r}, "
+                "want 'other' (scanner-minted series)"
+            )
+    return errors
+
+
+def main() -> int:
+    errors, labels, _non_path = lint_source()
+    if labels:
+        errors += lint_app(labels)
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}")
+        return 1
+    print(
+        f"ok: {len(labels)} probe route labels, derived sets in sync"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
