@@ -1959,6 +1959,273 @@ def config13_pod():
             pass
 
 
+def _mesh_slice_probe() -> dict:
+    """Replicated vs per-device-sliced mesh batch layout (ISSUE 13):
+    the SAME concurrent query mix driven through the tier with
+    BEACON_MESH_SLICE off and on. The headline is the per-device FLOP
+    proxy — evaluated (device, query-slot) pairs per launch — which
+    must scale ~1/n_dev on the sliced path (structural assert, never
+    wall-clock: the config13 virtual-device honesty rule applies).
+    Plus the plane-shape probe mirroring config13's worker_calls
+    comparison: a selected-samples query over 4 datasets costs 4
+    worker HTTP calls on the scatter topology and 0 on the tier."""
+    import random as _random
+    from concurrent.futures import ThreadPoolExecutor
+
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.parallel import mesh as mesh_mod
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+    from sbeacon_tpu.parallel.transport import PooledTransport
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
+
+    n_shards = 8
+
+    def mkshard(d):
+        return build_index(
+            random_records(
+                _random.Random(1700 + d), chrom="1", n=3000, n_samples=2
+            ),
+            dataset_id=f"sl{d}",
+            vcf_location=f"sl{d}.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+
+    shards = [mkshard(d) for d in range(n_shards)]
+    datasets = [s.meta["dataset_id"] for s in shards]
+
+    def payload(gran="count", include="HIT", **kw):
+        return VariantQueryPayload(
+            dataset_ids=datasets,
+            reference_name="1",
+            start_min=1200,
+            start_max=2200,
+            end_min=1,
+            end_max=1 << 30,
+            alternate_bases="N",
+            requested_granularity=gran,
+            include_datasets=include,
+            **kw,
+        )
+
+    def drive(dist, n_clients, per=4):
+        ts = []
+        lock = __import__("threading").Lock()
+
+        def client(_i):
+            for _ in range(per):
+                t0 = time.perf_counter()
+                dist.search(payload())
+                dt = (time.perf_counter() - t0) * 1e3
+                with lock:
+                    ts.append(dt)
+
+        with ThreadPoolExecutor(n_clients) as pool:
+            list(pool.map(client, range(n_clients)))
+        ts.sort()
+        return (
+            round(ts[len(ts) // 2], 3),
+            round(ts[int(0.99 * (len(ts) - 1))], 3),
+        )
+
+    def one_leg(slice_on: bool) -> dict:
+        eng = VariantEngine(
+            BeaconConfig(
+                engine=EngineConfig(
+                    use_mesh=False,
+                    microbatch_wait_ms=0.0,
+                    mesh_slice=slice_on,
+                )
+            )
+        )
+        for s in shards:
+            eng.add_index(s)
+        dist = DistributedEngine([], local=eng)
+        leg: dict = {"sliced": slice_on}
+        try:
+            dist.warmup()
+            for n_clients in (8, 16, 32):
+                e0 = mesh_mod.N_EVALUATED_PAIRS
+                l0 = mesh_mod.N_LAUNCHES
+                p50, p99 = drive(dist, n_clients)
+                pairs = mesh_mod.N_EVALUATED_PAIRS - e0
+                launches = mesh_mod.N_LAUNCHES - l0
+                n_queries = n_clients * 4
+                leg[f"c{n_clients}"] = {
+                    "p50_ms": p50,
+                    "p99_ms": p99,
+                    "launches": launches,
+                    "evaluated_pairs": pairs,
+                    "pairs_per_query": round(pairs / n_queries, 1),
+                }
+            st = dist.mesh_tier.stats()
+            leg["devices"] = st["devices"]
+            leg["dispatches"] = st["dispatches"]
+        finally:
+            dist.close()
+            eng.close()
+        return leg
+
+    out: dict = {"shards": n_shards}
+    out["replicated"] = one_leg(False)
+    out["sliced"] = one_leg(True)
+    n_dev = out["sliced"].get("devices", 1) or 1
+    ratios = {}
+    ok = True
+    for c in ("c8", "c16", "c32"):
+        rp = out["replicated"][c]["pairs_per_query"]
+        sp = out["sliced"][c]["pairs_per_query"]
+        ratios[c] = round(rp / sp, 2) if sp else None
+        # the structural bar: sliced per-device work is a real divisor
+        # of the replicated layout (~1/n_dev modulo tier padding)
+        ok = ok and sp * 2 <= rp
+    out["pairs_ratio_replicated_over_sliced"] = ratios
+    out["sliced_pairs_scale_structural_ok"] = ok
+    out["n_dev"] = n_dev
+
+    # -- plane-shape probe: worker_calls 4 -> 0 (config13 mirror) ------------
+    plane_sel = dict(
+        selected_samples_only=True,
+        sample_names={d: ["S1"] for d in datasets[:4]},
+    )
+    pshards = shards[:4]
+    pdatasets = datasets[:4]
+
+    def plane_payload():
+        return VariantQueryPayload(
+            dataset_ids=pdatasets,
+            reference_name="1",
+            start_min=1200,
+            start_max=2200,
+            end_min=1,
+            end_max=1 << 30,
+            alternate_bases="N",
+            requested_granularity="record",
+            include_datasets="ALL",
+            **plane_sel,
+        )
+
+    workers = []
+    for s in pshards:
+        weng = VariantEngine(
+            BeaconConfig(
+                engine=EngineConfig(
+                    microbatch=False, use_mesh=False, mesh_dispatch=False
+                )
+            )
+        )
+        weng.add_index(s)
+        workers.append(WorkerServer(weng).start_background())
+    transport = PooledTransport(pool_size=4)
+    http = DistributedEngine(
+        [w.address for w in workers], transport=transport
+    )
+    n_plane_queries = 20
+    try:
+        http.search(plane_payload())  # warm + discovery
+        m0 = transport.metrics()
+        for _ in range(n_plane_queries):
+            http.search(plane_payload())
+        m1 = transport.metrics()
+        calls = (m1["opened"] + m1["reused"]) - (m0["opened"] + m0["reused"])
+        out["plane_http"] = {
+            "worker_calls_per_query": round(calls / n_plane_queries, 2),
+        }
+    finally:
+        http.close()
+        for w in workers:
+            try:
+                w.shutdown()
+            except Exception:
+                pass
+    eng = VariantEngine(
+        BeaconConfig(
+            engine=EngineConfig(use_mesh=False, microbatch_wait_ms=0.0)
+        )
+    )
+    for s in pshards:
+        eng.add_index(s)
+    mesh = DistributedEngine([], local=eng)
+    try:
+        mesh.warmup()
+        l0 = mesh_mod.N_LAUNCHES
+        mesh.search(plane_payload())
+        st = mesh.mesh_tier.stats()
+        out["plane_mesh"] = {
+            "worker_calls_per_query": 0.0,
+            "launches_per_query": mesh_mod.N_LAUNCHES - l0,
+            "planes_stacked": st["planes"],
+            "dispatches": st["dispatches"],
+        }
+    finally:
+        mesh.close()
+        eng.close()
+    import jax
+
+    if jax.default_backend() != "tpu":
+        out["note"] = (
+            "cpu-virtual-device mesh: latencies measure the n-way "
+            "serialised emulation, not pod hardware (config13 honesty "
+            "rule); the structural wins — evaluated-pair scaling and "
+            "plane-shape worker_calls 4->0 — are topology-independent"
+        )
+    return out
+
+
+def config17_mesh_slice():
+    """Sliced vs replicated mesh batch probe. Runs inline on a real
+    multi-device mesh; on a single-device host the probe runs in a
+    child process with a forced 8-virtual-CPU mesh — the same shape
+    CI tests the shard_map program under (config13 pattern)."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        return _mesh_slice_probe()
+    import subprocess
+    import tempfile
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    with tempfile.NamedTemporaryFile(suffix=".json", delete=False) as f:
+        out_path = f.name
+    try:
+        code = (
+            "import json, sys, bench; "
+            "json.dump(bench._mesh_slice_probe(), open(sys.argv[1], 'w'))"
+        )
+        proc = subprocess.run(
+            [sys.executable, "-c", code, out_path],
+            env=env,
+            cwd=here,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=420,
+        )
+        if proc.returncode != 0:
+            return {
+                "error": "mesh-slice probe subprocess failed: "
+                + proc.stdout[-300:]
+            }
+        with open(out_path) as fh:
+            out = json.load(fh)
+        out["forced_cpu_devices"] = 8
+        return out
+    finally:
+        try:
+            os.unlink(out_path)
+        except OSError:
+            pass
+
+
 def config14_ingest_serve():
     """Ingest-while-serving soak (ISSUE 10): continuous small-VCF
     submissions stream delta shards into a serving engine (base publish
@@ -2823,6 +3090,7 @@ def main() -> None:
     run("config14_ingest_serve", 90, config14_ingest_serve)
     run("config15_cost", 45, config15_cost)
     run("config16_fleet", 45, config16_fleet)
+    run("config17_mesh_slice", 120, config17_mesh_slice)
     emit(final=True)
 
 

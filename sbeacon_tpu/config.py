@@ -16,6 +16,12 @@ import json
 import os
 from pathlib import Path
 
+#: THE falsy spellings for boolean env knobs — ``from_env`` and every
+#: module that reads a BEACON_* flag directly (parallel/mesh.py's
+#: BEACON_MESH_SLICE default) share this one set, so an env value can
+#: never mean "off" to one reader and "on" to another
+ENV_OFF = ("0", "false", "no", "off")
+
 
 @dataclasses.dataclass(frozen=True)
 class BeaconInfo:
@@ -115,6 +121,20 @@ class EngineConfig:
     # mesh path (below it, per-shard dispatch is already one launch).
     mesh_dispatch: bool = True
     mesh_min_shards: int = 2
+    # per-device query-batch slicing on the mesh tier (ISSUE 13): the
+    # encoded batch is sharded by owning device (owner-sorted permute,
+    # per-device counts padded to a shared tier) so each device
+    # evaluates only the queries targeting its shards — ~1/n_dev the
+    # per-device work — instead of the full replicated batch masked by
+    # ownership. Off restores the replicated layout.
+    mesh_slice: bool = True
+    # stack the genotype planes with their datasets on the mesh tier
+    # when every shard has them and the per-device slice fits the
+    # plane_hbm_budget_gb headroom: selected-samples / sample-
+    # extraction shapes then ride the same single launch (per-query
+    # sample masks reduced on the owning device) instead of falling
+    # back to per-dataset dispatch.
+    mesh_planes: bool = True
     ingest_shard_bytes: int = 64 * 1024 * 1024
     ingest_workers: int = 8
     max_response_inline_bytes: int = 300 * 1024  # performQuery spill threshold
@@ -512,27 +532,31 @@ class BeaconConfig:
             eng_over["window_cap"] = int(env["BEACON_WINDOW_CAP"])
         if "BEACON_RECORD_CAP" in env:
             eng_over["record_cap"] = int(env["BEACON_RECORD_CAP"])
+        _off = ENV_OFF
         if "BEACON_USE_TPU" in env:
-            eng_over["use_tpu"] = env["BEACON_USE_TPU"].lower() not in (
-                "0",
-                "false",
-                "no",
-                "off",
-            )
+            eng_over["use_tpu"] = env["BEACON_USE_TPU"].lower() not in _off
         if "BEACON_USE_MESH" in env:
-            eng_over["use_mesh"] = env["BEACON_USE_MESH"].lower() not in (
-                "0",
-                "false",
-                "no",
-                "off",
+            eng_over["use_mesh"] = (
+                env["BEACON_USE_MESH"].lower() not in _off
             )
-        _off = ("0", "false", "no", "off")
         if "BEACON_MESH_DISPATCH" in env:
             eng_over["mesh_dispatch"] = (
                 env["BEACON_MESH_DISPATCH"].lower() not in _off
             )
         if "BEACON_MESH_MIN_SHARDS" in env:
             eng_over["mesh_min_shards"] = int(env["BEACON_MESH_MIN_SHARDS"])
+        if "BEACON_MESH_SLICE" in env:
+            eng_over["mesh_slice"] = (
+                env["BEACON_MESH_SLICE"].lower() not in _off
+            )
+        if "BEACON_MESH_PLANES" in env:
+            eng_over["mesh_planes"] = (
+                env["BEACON_MESH_PLANES"].lower() not in _off
+            )
+        if "BEACON_PLANE_HBM_BUDGET_GB" in env:
+            eng_over["plane_hbm_budget_gb"] = float(
+                env["BEACON_PLANE_HBM_BUDGET_GB"]
+            )
         if "BEACON_FUSED_DISPATCH" in env:
             eng_over["fused_dispatch"] = (
                 env["BEACON_FUSED_DISPATCH"].lower() not in _off

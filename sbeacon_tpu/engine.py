@@ -806,11 +806,7 @@ class VariantEngine:
             # plane-less above, so every remaining p counts) + EVERY
             # in-flight reservation, including concurrent uploads of
             # this same key — each holds its own token
-            used = sum(
-                p.nbytes_hbm()
-                for _k, (_s, _d, p) in self._indexes.items()
-                if p is not None
-            ) + sum(self._plane_reserved.values())
+            used = self._plane_hbm_resident_locked()
             if used + est > budget:
                 over = True
             else:
@@ -1258,6 +1254,75 @@ class VariantEngine:
         from this instead of iterating ``_indexes`` mid-ingest."""
         with self._mesh_lock:
             return [(k, v[0]) for k, v in sorted(self._indexes.items())]
+
+    def index_snapshot(
+        self,
+    ) -> list[tuple[tuple[str, str], object, object]]:
+        """Sorted ``[((dataset_id, vcf_location), shard, plane_index),
+        ...]`` under the publish lock — :meth:`shard_snapshot` plus the
+        device plane index per key, so the pod dispatch tier's plane-
+        stacked build pairs each shard with the exact planes of the
+        same publish (never a concurrently re-ingested replacement)."""
+        with self._mesh_lock:
+            return [
+                (k, v[0], v[2]) for k, v in sorted(self._indexes.items())
+            ]
+
+    def _plane_hbm_resident_locked(self) -> int:
+        """resident per-dataset planes + every reservation, under the
+        publish lock — THE one summation all three budget gates share
+        (the upload gate, ``_mesh_ready``'s stack gate, and the
+        dispatch tier via :meth:`plane_hbm_resident`), so the
+        accounting can never disagree between them."""
+        return sum(
+            p.nbytes_hbm()
+            for _s, _d, p in self._indexes.values()
+            if p is not None
+        ) + sum(self._plane_reserved.values())
+
+    def plane_hbm_resident(self) -> int:
+        """Bytes of HBM already committed to per-dataset genotype-plane
+        uploads (resident plane indexes + in-flight reservations) —
+        the dispatch tier's plane-stack budget gates against this, the
+        same accounting ``_mesh_ready``'s own gate applies."""
+        with self._mesh_lock:
+            return self._plane_hbm_resident_locked()
+
+    def register_plane_bytes(self, token, nbytes: int) -> None:
+        """Account an EXTERNAL standing plane allocation (the mesh
+        dispatch tier's group-stacked planes) against the plane HBM
+        budget: it rides the same reservation ledger the per-dataset
+        upload gate sums, so a post-build dataset upload cannot
+        overcommit the device by the stack's size (the accounting is
+        bidirectional — the tier's gate reads resident+reserved via
+        :meth:`plane_hbm_resident`, and uploads see the tier's stack
+        here). ``nbytes <= 0`` releases; re-registering the same token
+        replaces (the tier's rebuild semantics)."""
+        with self._mesh_lock:
+            if nbytes > 0:
+                self._plane_reserved[token] = int(nbytes)
+            else:
+                self._plane_reserved.pop(token, None)
+
+    def try_reserve_plane_bytes(
+        self, token, nbytes: int, budget: float
+    ) -> bool:
+        """Atomic check-and-reserve for an external plane allocation:
+        headroom test and ledger write under ONE publish-lock hold, the
+        same discipline the per-dataset upload gate applies — a
+        two-step read-compare-register leaves a window in which a
+        concurrent upload's gate sees neither party's bytes and both
+        overcommit. The token's own previous reservation is excluded
+        from the headroom (it is being replaced by ``nbytes``, which
+        should already include whatever of it still stands). Returns
+        False (ledger untouched) when ``nbytes`` does not fit."""
+        with self._mesh_lock:
+            prev = self._plane_reserved.get(token, 0)
+            used = self._plane_hbm_resident_locked() - prev
+            if used + nbytes > budget:
+                return False
+            self._plane_reserved[token] = int(nbytes)
+            return True
 
     def index_fingerprint(self) -> str:
         """FULL identity of the served data set — base shards AND the
@@ -2094,11 +2159,7 @@ class VariantEngine:
                         n_datasets_padded=d_pad,
                         n_mesh=n_mesh,
                     )
-                    resident = sum(
-                        p.nbytes_hbm()
-                        for _s, _d, p in self._indexes.values()
-                        if p is not None
-                    ) + sum(self._plane_reserved.values())
+                    resident = self._plane_hbm_resident_locked()
                     budget = (
                         getattr(eng, "plane_hbm_budget_gb", 11.0) * 1e9
                     )

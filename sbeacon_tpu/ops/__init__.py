@@ -46,6 +46,8 @@ def run_queries_auto(
     window_cap: int = 2048,
     record_cap: int = 1024,
     async_fetch: bool = False,
+    sample_masks=None,
+    mask_counts=None,
 ):
     """Dispatch a query batch to whichever kernel the index was built
     for — one call site for the engine and the micro-batcher.
@@ -54,8 +56,17 @@ def run_queries_auto(
     QueryResults`` immediately after the launch is dispatched so the
     caller can overlap host work with device execution (the scatter
     tile kernels execute synchronously and return already-fetched
-    results behind the same contract)."""
+    results behind the same contract).
+
+    ``sample_masks``/``mask_counts`` arm the mesh tier's genotype-plane
+    program (per-query sample masks reduced on the owning device) and
+    are only meaningful for a plane-stacked MeshFusedIndex — passing
+    them for any other index family is a caller bug and raises."""
     if isinstance(index, ScatterDeviceIndex):
+        if sample_masks is not None:
+            raise ValueError(
+                "sample_masks only ride the mesh plane program"
+            )
         res = run_queries_scattered(
             index, queries, window_cap=window_cap, record_cap=record_cap
         )
@@ -65,12 +76,20 @@ def run_queries_auto(
     # the micro-batcher coalesces onto it exactly like a FusedDeviceIndex
     mesh_run = getattr(index, "run_mesh_queries", None)
     if mesh_run is not None:
+        kwargs = {}
+        if sample_masks is not None:
+            kwargs.update(
+                sample_masks=sample_masks, mask_counts=mask_counts
+            )
         return mesh_run(
             queries,
             window_cap=window_cap,
             record_cap=record_cap,
             async_fetch=async_fetch,
+            **kwargs,
         )
+    if sample_masks is not None:
+        raise ValueError("sample_masks only ride the mesh plane program")
     return run_queries(
         index,
         queries,

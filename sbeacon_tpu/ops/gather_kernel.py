@@ -103,3 +103,28 @@ def gather_partials(x, axis: str, n_dev: int, *, impl: str = "portable"):
     if impl == "pallas":
         return gather_partials_tpu(x, axis, n_dev)
     return gather_partials_portable(x, axis)
+
+
+def gather_partials_many(xs, axis: str, n_dev: int, *, impl: str = "portable"):
+    """ONE combined gather pass over several partial blocks.
+
+    The mesh plane program produces four per-query blocks to reassemble
+    (hit rows, masked call/token popcounts, the sample-hit OR words) —
+    all int32, all sharing the leading batch axis. Ring-combining them
+    separately costs 4x(n-1) ICI hops and 4 semaphore pairs per step;
+    concatenating along the trailing axis first makes it ONE ring pass
+    (n-1 hops) over a single contiguous block, then a free split. The
+    portable path concatenates too, so both implementations see the
+    identical block layout."""
+    xs = tuple(xs)
+    if len(xs) == 1:
+        return (gather_partials(xs[0], axis, n_dev, impl=impl),)
+    # split points are static shape arithmetic (python ints, never
+    # tracers — jnp.split needs concrete indices inside the trace)
+    splits, acc = [], 0
+    for x in xs[:-1]:
+        acc += int(x.shape[-1])
+        splits.append(acc)
+    cat = jnp.concatenate(xs, axis=-1)
+    out = gather_partials(cat, axis, n_dev, impl=impl)
+    return tuple(jnp.split(out, splits, axis=-1))

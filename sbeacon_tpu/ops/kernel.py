@@ -279,6 +279,13 @@ class QueryResults:
     n_matched: np.ndarray  # int32[B]
     overflow: np.ndarray  # bool[B] — window_cap exceeded, host fallback
     rows: np.ndarray  # int32[B, record_cap] global row ids, -1 padded
+    # genotype-plane outputs (mesh plane program only; None on every
+    # match-only path): per-row masked popcounts aligned with ``rows``
+    # and the grp>=k0 sample-hit OR — the materialize_response
+    # ``fused=(pc_call, pc_tok, or_words)`` triple, per query
+    pc_call: np.ndarray | None = None  # int32[B, record_cap]
+    pc_tok: np.ndarray | None = None  # int32[B, record_cap]
+    or_words: np.ndarray | None = None  # int32[B, plane_words]
 
 
 def _bisect(pos, target, lo0, hi0, n_iters, *, upper: bool):
@@ -452,6 +459,11 @@ class PendingQueryResults:
         out = jax.device_get(self._out)
         self._out = None  # free the device buffers promptly
         b = self._b
+        extra = {
+            k: np.asarray(out[k])[:b]
+            for k in ("pc_call", "pc_tok", "or_words")
+            if k in out
+        }
         return QueryResults(
             exists=np.asarray(out["exists"])[:b],
             call_count=np.asarray(out["call_count"])[:b],
@@ -460,6 +472,7 @@ class PendingQueryResults:
             n_matched=np.asarray(out["n_matched"])[:b],
             overflow=np.asarray(out["overflow"])[:b],
             rows=np.asarray(out["rows"])[:b],
+            **extra,
         )
 
 

@@ -43,6 +43,8 @@ import threading
 import time
 import urllib.error
 import concurrent.futures as futures_mod
+
+import numpy as np
 from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
@@ -534,6 +536,15 @@ def register_dispatch_metrics(registry, supplier) -> None:
         "mesh.gather_rows",
         "hit rows gathered on-device by the mesh tier's row gather",
         fn=field("mesh_gather_rows"),
+    )
+    registry.counter(
+        "mesh.refusals",
+        "queries the mesh tier declined, by reason (planes = "
+        "plane-reading shape the stack cannot serve, stale = publish "
+        "outran the stack, min_shards = too few local targets, "
+        "unbuilt = no stack yet)",
+        label="reason",
+        fn=lambda: supplier().get("mesh_refusals", {}) or {},
     )
     # fleet federation (ISSUE 12): the digest-poll plane's own series
     registry.counter(
@@ -1169,7 +1180,8 @@ class MeshDispatchTier:
         self.axis = axis
         self._devices = devices
         self._lock = threading.Lock()
-        # (MeshFusedIndex, {key: sid}, {key: shard}, {ds: [keys]}, fp)
+        # (MeshFusedIndex, {key: sid}, {key: shard}, {ds: [keys]}, fp,
+        #  {key: plane_index})
         self._state: tuple | None = None
         self._building = False
         # fingerprint a build pass declined (too few shards / build
@@ -1179,6 +1191,20 @@ class MeshDispatchTier:
         self._dispatches = 0
         self._fallbacks = 0
         self._gather_rows = 0
+        # why queries fell off the tier, by reason — the operator's
+        # answer to "the mesh dispatch rate dropped, what happened?"
+        # (mesh.refusals{reason} series): planes = plane-reading shape
+        # the stack cannot serve (no planes stacked / wildcard-ref
+        # host semantics), stale = built but a publish outran it,
+        # min_shards = too few local targets to beat per-shard
+        # dispatch, unbuilt = no stack yet (incl. <2 devices and
+        # declined builds)
+        self._refusals: dict[str, int] = {}
+        # close() raced against an in-flight background build: the
+        # build re-checks this before publishing/registering so a dead
+        # tier can never leave a phantom plane-byte reservation (or a
+        # resurrected state) behind
+        self._tier_closed = False
 
     # -- availability / build ----------------------------------------------
 
@@ -1194,13 +1220,29 @@ class MeshDispatchTier:
         return len(devs) >= 2
 
     def _snapshot(self):
-        """(keys, shards) the stack would build from, via the engine's
-        locked snapshot (never iterating ``_indexes`` mid-ingest)."""
+        """(keys, shards, planes_of) the stack would build from, via
+        the engine's locked snapshot (never iterating ``_indexes``
+        mid-ingest). ``planes_of`` maps keys to the per-dataset device
+        plane index of the SAME publish — materialisation's host/
+        device fallback for shapes the stacked planes cannot answer
+        exactly."""
+        snap = getattr(self.engine, "index_snapshot", None)
+        if snap is not None:
+            triples = snap()
+            return (
+                [k for k, _s, _p in triples],
+                [s for _k, s, _p in triples],
+                {k: p for k, _s, p in triples},
+            )
         snap = getattr(self.engine, "shard_snapshot", None)
         if snap is None:
-            return [], []
+            return [], [], {}
         pairs = snap()
-        return [k for k, _s in pairs], [s for _k, s in pairs]
+        return (
+            [k for k, _s in pairs],
+            [s for _k, s in pairs],
+            {},
+        )
 
     def _base_fp(self) -> str:
         """The BASE-shard fingerprint: stable across delta publishes
@@ -1224,6 +1266,8 @@ class MeshDispatchTier:
         fp = self._base_fp()
         while True:
             with self._lock:
+                if self._tier_closed:
+                    return None
                 state = self._state
                 if state is not None and state[4] == fp:
                     return state
@@ -1250,40 +1294,147 @@ class MeshDispatchTier:
         try:
             from .mesh import MeshFusedIndex, make_mesh
 
-            keys, shards = self._snapshot()
+            keys, shards, planes_of = self._snapshot()
             if len(keys) < self.min_shards:
                 with self._lock:
                     self._skip_fp = fp
                 return None
             mesh = make_mesh(devices=self._devices, axis=self.axis)
-            index = MeshFusedIndex(shards, mesh, axis=self.axis)
+            eng_cfg = getattr(self.engine.config, "engine", None)
+            reg = getattr(self.engine, "register_plane_bytes", None)
+            # the PREVIOUS stack's registered bytes: it keeps serving
+            # until the new state publishes, so it stays accounted
+            # through the build (and is what a failed build restores)
+            with self._lock:
+                prev_bytes = (
+                    getattr(self._state[0], "plane_bytes_device", 0)
+                    if self._state is not None
+                    else 0
+                )
+            # stack the genotype planes with their datasets when the
+            # knob allows, every shard has them, and the per-device
+            # slice fits the HBM headroom left by the resident
+            # per-dataset planes (the engine's own mesh gate, applied
+            # through the index's one-source-of-truth byte math)
+            with_planes = getattr(eng_cfg, "mesh_planes", True) and all(
+                s.gt_bits is not None for s in shards
+            )
+            if with_planes:
+                per_dev = MeshFusedIndex.plane_bytes_per_device(
+                    shards, n_dev=int(mesh.devices.size)
+                )
+                budget = (
+                    getattr(eng_cfg, "plane_hbm_budget_gb", 11.0) * 1e9
+                )
+                # ATOMIC check-and-reserve BEFORE the multi-second
+                # stack build (the engine's own upload-gate
+                # discipline): the headroom test and the ledger write
+                # happen under one lock hold, and the reservation
+                # covers the old still-serving stack PLUS the build in
+                # flight — a per-dataset plane upload admitted
+                # mid-build sees these bytes, so the two gates cannot
+                # both pass on the same headroom
+                reserve = getattr(
+                    self.engine, "try_reserve_plane_bytes", None
+                )
+                if reserve is not None:
+                    with_planes = reserve(
+                        self, prev_bytes + per_dev, budget
+                    )
+                else:
+                    resident = getattr(
+                        self.engine, "plane_hbm_resident", lambda: 0
+                    )()
+                    with_planes = per_dev + resident <= budget
+                if not with_planes:
+                    log.info(
+                        "mesh tier planes skipped: %d B/device does "
+                        "not fit the %.1f GB plane budget headroom",
+                        per_dev,
+                        budget / 1e9,
+                    )
+            index = MeshFusedIndex(
+                shards,
+                mesh,
+                axis=self.axis,
+                with_planes=with_planes,
+                slice_batch=getattr(eng_cfg, "mesh_slice", None),
+            )
             sid_of = {k: i for i, k in enumerate(keys)}
             shard_of = dict(zip(keys, shards))
             keys_by_ds: dict[str, list] = {}
             for k in keys:
                 keys_by_ds.setdefault(k[0], []).append(k)
-            state = (index, sid_of, shard_of, keys_by_ds, fp)
+            state = (index, sid_of, shard_of, keys_by_ds, fp, planes_of)
             with self._lock:
+                if self._tier_closed:
+                    # close() won the race: discard the build outright
+                    if reg is not None:
+                        reg(self, 0)
+                    return None
                 self._state = state
+            # settle the bidirectional budget accounting on the NEW
+            # stack alone (keyed on the tier, so this replaces the
+            # build-window reservation — and a plane-less rebuild
+            # releases the old stack's bytes); later per-dataset
+            # uploads then cannot overcommit the device by the stack
+            if reg is not None:
+                reg(self, index.plane_bytes_device)
+                with self._lock:
+                    raced_close = self._tier_closed
+                if raced_close:
+                    # close() landed between the publish above and the
+                    # settle: its release must win, not our registration
+                    reg(self, 0)
+                    return None
             publish_event(
                 "mesh.tier_ready",
                 shards=len(keys),
                 devices=index.n_dev,
+                planes=index.has_planes,
             )
             log.info(
-                "mesh dispatch tier ready: %d shards over %d devices",
+                "mesh dispatch tier ready: %d shards over %d devices"
+                " (planes %s)",
                 len(keys),
                 index.n_dev,
+                "stacked" if index.has_planes else "off",
             )
             return state
         except Exception:
             log.exception("mesh dispatch tier build failed; scatter serves")
             with self._lock:
                 self._skip_fp = fp
+            # roll the build-window plane reservation back to whatever
+            # stack is actually still serving (re-derived from state, so
+            # this is correct wherever in the build the failure landed)
+            reg = getattr(self.engine, "register_plane_bytes", None)
+            if reg is not None:
+                with self._lock:
+                    prev = (
+                        getattr(self._state[0], "plane_bytes_device", 0)
+                        if self._state is not None
+                        else 0
+                    )
+                reg(self, prev)
             return None
         finally:
             with self._lock:
                 self._building = False
+
+    def close(self) -> None:
+        """Drop the tier's state and release its plane-stack bytes from
+        the engine's budget ledger — a discarded tier must not keep the
+        ledger over-counting (and the ledger's strong reference would
+        otherwise pin the stack's device arrays alive). The flag is set
+        BEFORE the release so an in-flight background build observes it
+        at its publish/settle re-checks and discards itself."""
+        with self._lock:
+            self._tier_closed = True
+            self._state = None
+        reg = getattr(self.engine, "register_plane_bytes", None)
+        if reg is not None:
+            reg(self, 0)
 
     def warmup(self) -> int:
         """Build inline and pre-compile the tier's batch-tier programs;
@@ -1296,41 +1447,90 @@ class MeshDispatchTier:
         index = state[0]
         eng = self.engine.config.engine
         n = 0
-        for t in self.WARM_TIERS:
+        spec = QuerySpec("1", 1, 1, 1, 2)
+        # the sliced layout keys programs on the PER-DEVICE slice tier:
+        # a single-hot-shard batch of t slices to C=t, while the common
+        # pod fan-out (<= one query per device) slices to C=1 — warm
+        # both shapes so neither pays a mid-request shard_map compile
+        spread = [
+            g * index.d_local
+            for g in range(index.n_dev)
+            if g * index.d_local < index.n_shards
+        ]
+        batches = [[0] * t for t in self.WARM_TIERS] + [spread]
+        for sids in batches:
             index.run_mesh_queries(
-                encode_queries(
-                    [QuerySpec("1", 1, 1, 1, 2)] * t, shard_ids=[0] * t
-                ),
+                encode_queries([spec] * len(sids), shard_ids=sids),
                 window_cap=eng.window_cap,
                 record_cap=eng.record_cap,
             )
             n += 1
+        if index.has_planes:
+            # the plane program at the SAME shapes as the match warm —
+            # a selected-samples burst coalescing to any warmed tier
+            # must not pay a mid-request shard_map compile any more
+            # than a boolean one would
+            for sids in batches:
+                index.run_mesh_queries(
+                    encode_queries([spec] * len(sids), shard_ids=sids),
+                    window_cap=eng.window_cap,
+                    record_cap=eng.record_cap,
+                    sample_masks=np.zeros(
+                        (len(sids), index.plane_words), np.uint32
+                    ),
+                    mask_counts=np.zeros(len(sids), np.bool_),
+                )
+                n += 1
         return n
 
     # -- per-query consult ---------------------------------------------------
 
+    def _note_refusal(self, reason: str) -> None:
+        with self._lock:
+            self._refusals[reason] = self._refusals.get(reason, 0) + 1
+
+    def _is_plane_query(self, payload) -> bool:
+        """Plane-reading response shape — the predicate IS the
+        engine's (_wants_planes), not a copy that could drift."""
+        wants_planes = getattr(self.engine, "_wants_planes", None)
+        return payload.selected_samples_only or (
+            wants_planes is not None and wants_planes(payload)
+        )
+
     def resolve(self, dataset_ids, payload) -> set:
         """The subset of ``dataset_ids`` this tier will serve for this
         query — empty when the tier should not engage (unbuilt/stale
-        stack, plane-reading response shape, below ``min_shards``)."""
+        stack, a plane-reading shape the stack cannot answer, below
+        ``min_shards``). Every refusal is reason-labeled into the
+        ``mesh.refusals`` series so operators can see why traffic
+        falls off the tier."""
         if not dataset_ids:
-            return set()
-        # plane-reading shapes (selected-samples leaf, sample-hit
-        # extraction) materialise through per-dataset genotype planes —
-        # those stay on the engine's existing paths. The predicate IS
-        # the engine's (_wants_planes), not a copy that could drift.
-        wants_planes = getattr(self.engine, "_wants_planes", None)
-        if payload.selected_samples_only or (
-            wants_planes is not None and wants_planes(payload)
-        ):
             return set()
         state = self._ready()
         if state is None:
+            with self._lock:
+                built = self._state is not None
+            self._note_refusal("stale" if built else "unbuilt")
             return set()
-        _index, _sid_of, _shard_of, keys_by_ds, _fp = state
+        index = state[0]
+        if self._is_plane_query(payload):
+            # plane shapes ride the single launch when the stack
+            # carries the genotype planes AND device row-matching is
+            # exact for this query (an N-wildcard ref needs host regex
+            # semantics — the engine's own predicate decides, payload
+            # doubles as the spec arg since only reference_bases is
+            # read); otherwise they keep the per-dataset engine paths
+            ref_ok = getattr(self.engine, "_device_ref_ok", None)
+            if not index.has_planes or (
+                ref_ok is not None and not ref_ok(payload, payload)
+            ):
+                self._note_refusal("planes")
+                return set()
+        _index, _sid_of, _shard_of, keys_by_ds, _fp = state[:5]
         covered = {ds for ds in dataset_ids if ds in keys_by_ds}
         n_targets = sum(len(keys_by_ds[ds]) for ds in covered)
         if n_targets < self.min_shards:
+            self._note_refusal("min_shards")
             return set()
         return covered
 
@@ -1350,7 +1550,9 @@ class MeshDispatchTier:
             state = self._state
         if state is None:
             raise WorkerError("mesh tier state gone")
-        index, sid_of, shard_of, keys_by_ds, _fp = state
+        index, sid_of, shard_of, keys_by_ds, _fp = state[:5]
+        planes_of = state[5] if len(state) > 5 else {}
+        plane_q = self._is_plane_query(payload)
         spec_base = QuerySpec(
             chrom=payload.reference_name,
             start_min=payload.start_min,
@@ -1381,7 +1583,7 @@ class MeshDispatchTier:
         delta_targets = []
         indexes_for = getattr(self.engine, "indexes_for", None)
         if indexes_for is not None:
-            for ds, vcf, (shard, _di, _pl) in indexes_for(
+            for ds, vcf, (shard, _di, pl) in indexes_for(
                 sorted(dataset_ids)
             ):
                 if (ds, vcf) in sid_of:
@@ -1391,15 +1593,47 @@ class MeshDispatchTier:
                 )
                 if native is None:
                     continue
-                delta_targets.append(((ds, vcf), shard, native))
+                delta_targets.append(((ds, vcf), shard, native, pl))
         if not targets and not delta_targets:
             return []
         eng = self.engine.config.engine
         responses = []
         gathered = 0
+
+        def _sel_idx(shard, ds):
+            # the engine's own name->index resolution, per shard
+            if not payload.selected_samples_only:
+                return None
+            return self.engine._selected_idx(shard, payload, ds)
+
         if targets:
             specs = [spec_base] * len(targets)
             sids = [sid for _k, _s, _n, sid in targets]
+            sel_idx_of: dict = {}
+            masks = None
+            mask_counts = None
+            if plane_q:
+                # per-query sample masks, sharded WITH the batch: the
+                # owning device reduces each query's matched rows under
+                # ITS mask inside the same single launch. Selected-
+                # samples queries restrict to the named samples (and
+                # switch to genotype-derived counting when the count
+                # planes are stacked); extraction shapes take the
+                # full-cohort mask and keep the INFO-column counts —
+                # materialize only consumes their or_words.
+                from ..ops.plane_kernel import sample_mask_words
+
+                W = index.plane_words
+                masks = np.zeros((len(targets), W), np.uint32)
+                mask_counts = np.zeros(len(targets), np.bool_)
+                for i, (key, shard, _native, _sid) in enumerate(targets):
+                    if payload.selected_samples_only:
+                        sel = _sel_idx(shard, key[0])
+                        sel_idx_of[key] = sel
+                        masks[i] = sample_mask_words(sel, W)
+                        mask_counts[i] = index.has_count_planes
+                    else:
+                        masks[i] = 0xFFFFFFFF
             batcher = getattr(self.engine, "batcher", None)
             if batcher is not None:
                 # the serving micro-batcher coalesces concurrent pod
@@ -1411,6 +1645,8 @@ class MeshDispatchTier:
                     shard_ids=sids,
                     window_cap=eng.window_cap,
                     record_cap=eng.record_cap,
+                    sample_masks=masks,
+                    mask_counts=mask_counts,
                 )
             else:
                 fault_point("kernel.launch")
@@ -1418,15 +1654,50 @@ class MeshDispatchTier:
                     encode_queries(specs, shard_ids=sids),
                     window_cap=eng.window_cap,
                     record_cap=eng.record_cap,
+                    sample_masks=masks,
+                    mask_counts=mask_counts,
                 )
             for i, (key, shard, native, _sid) in enumerate(targets):
+                sel_idx = sel_idx_of.get(key)
+                fused = None
                 if res.overflow[i] or res.n_matched[i] > eng.record_cap:
                     # window/record overflow: uncapped host matcher,
                     # the same contract as every device kernel path
-                    rows = host_match_rows(shard, spec_base)
+                    rows = host_match_rows(
+                        shard,
+                        spec_base,
+                        ref_wildcard=payload.selected_samples_only,
+                    )
                 else:
-                    rows = res.rows[i][res.rows[i] >= 0]
+                    keep = res.rows[i] >= 0
+                    rows = res.rows[i][keep]
                     gathered += int(rows.size)
+                    # the fused triple is only exact for this shard
+                    # when its count-plane availability matches the
+                    # stack-wide static (a shard WITH count planes in
+                    # a stack that ran has_counts=False was counted
+                    # full-cohort on device) — extraction shapes only
+                    # read or_words, which is count-plane-invariant
+                    if (
+                        plane_q
+                        and res.or_words is not None
+                        and (
+                            not payload.selected_samples_only
+                            or index.has_count_planes
+                            or not shard.has_count_planes
+                        )
+                    ):
+                        # or_words come back stack-wide (plane_words =
+                        # the widest shard); materialise in this
+                        # shard's own width (tail words are zero by
+                        # construction)
+                        w_shard = shard.gt_bits.shape[1]
+                        fused = (
+                            res.pc_call[i][keep],
+                            res.pc_tok[i][keep],
+                            np.asarray(res.or_words[i])
+                            .view(np.uint32)[:w_shard],
+                        )
                 responses.append(
                     materialize_response(
                         shard,
@@ -1435,6 +1706,11 @@ class MeshDispatchTier:
                         chrom_label=native,
                         dataset_id=key[0],
                         vcf_location=key[1],
+                        selected_idx=sel_idx,
+                        plane_index=(
+                            planes_of.get(key) if plane_q else None
+                        ),
+                        fused=fused,
                     )
                 )
         # only the delta tail pays per-shard dispatch (host matching —
@@ -1442,15 +1718,21 @@ class MeshDispatchTier:
         # cost-attributed to the request like the engine's own tail
         if delta_targets:
             charge_cost(delta_shards=len(delta_targets))
-        for key, shard, native in delta_targets:
+        for key, shard, native, pl in delta_targets:
             responses.append(
                 materialize_response(
                     shard,
-                    host_match_rows(shard, spec_base),
+                    host_match_rows(
+                        shard,
+                        spec_base,
+                        ref_wildcard=payload.selected_samples_only,
+                    ),
                     payload,
                     chrom_label=native,
                     dataset_id=key[0],
                     vcf_location=key[1],
+                    selected_idx=_sel_idx(shard, key[0]),
+                    plane_index=pl if plane_q else None,
                 )
             )
         with self._lock:
@@ -1459,7 +1741,11 @@ class MeshDispatchTier:
         # the dispatch_tier note belongs to DistributedEngine.search —
         # it knows whether this query was mesh-only or "mixed" with a
         # scatter leg; writing it here would overwrite that label
-        annotate(mesh_shards=len(targets), mesh_delta_tail=len(delta_targets))
+        annotate(
+            mesh_shards=len(targets),
+            mesh_delta_tail=len(delta_targets),
+            mesh_planes=plane_q,
+        )
         return responses
 
     def note_fallback(self) -> None:
@@ -1473,10 +1759,12 @@ class MeshDispatchTier:
                 "dispatches": self._dispatches,
                 "fallbacks": self._fallbacks,
                 "gather_rows": self._gather_rows,
+                "refusals": dict(self._refusals),
             }
         out["ready"] = state is not None
         out["shards"] = len(state[1]) if state is not None else 0
         out["devices"] = state[0].n_dev if state is not None else 0
+        out["planes"] = bool(state[0].has_planes) if state else False
         return out
 
 
@@ -1919,6 +2207,7 @@ class DistributedEngine:
                 "mesh_dispatches": mesh.get("dispatches", 0),
                 "mesh_fallbacks": mesh.get("fallbacks", 0),
                 "mesh_gather_rows": mesh.get("gather_rows", 0),
+                "mesh_refusals": mesh.get("refusals", {}),
                 "fleet_polls": fleet.get("polls", 0),
                 "fleet_reachable": fleet.get("reachable", 0),
                 "fleet_divergent": fleet.get("divergent", 0),
@@ -1964,6 +2253,8 @@ class DistributedEngine:
         and drop the pooled worker connections (engines are long-lived;
         call this when rebuilding one on config/route changes)."""
         self._closed.set()
+        if self.mesh_tier is not None:
+            self.mesh_tier.close()
         self._pool.shutdown(wait=False, cancel_futures=True)
         # under _sc_lock, paired with _hedge_pool's closed check: a
         # hedge executor created concurrently with close() must not

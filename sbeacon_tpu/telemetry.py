@@ -723,6 +723,7 @@ ANNOTATION_KEYS = frozenset({
     "lane",
     "mesh_delta_tail",
     "mesh_fallback",
+    "mesh_planes",
     "mesh_shards",
     "query_job",
     "replica_hedge",
@@ -953,10 +954,12 @@ class EventJournal:
 
 
 def _env_journal() -> EventJournal:
+    from .config import ENV_OFF
+
     size = os.environ.get("BEACON_EVENT_JOURNAL_SIZE", "") or "1024"
     enabled = os.environ.get(
         "BEACON_EVENT_JOURNAL_ENABLED", ""
-    ).lower() not in ("0", "false", "no", "off")
+    ).lower() not in ENV_OFF
     try:
         keep = int(size)
     except ValueError:

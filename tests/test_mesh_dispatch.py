@@ -159,6 +159,251 @@ def test_mesh_fused_index_requires_shard_ids():
         mfi.run_mesh_queries(enc, window_cap=2048, record_cap=64)
 
 
+@multi_device
+def test_run_mesh_queries_bare_list_is_loud():
+    """Satellite bugfix (ISSUE 13): a bare spec list used to silently
+    encode ``shard_ids=[0]*n`` — every query answered against shard
+    0's row span, wrong for any other target. Now a loud error."""
+    from sbeacon_tpu.ops.kernel import QuerySpec
+
+    shards = _shards(2)
+    mfi = MeshFusedIndex(shards, make_mesh())
+    with pytest.raises(ValueError, match="explicit shard ids"):
+        mfi.run_mesh_queries(
+            [QuerySpec("1", 1, 10, 1, 20)], window_cap=2048, record_cap=64
+        )
+
+
+@multi_device
+def test_sliced_layout_parity_and_eval_pair_scaling():
+    """The per-device sliced batch layout must answer every (shard,
+    query) pair byte-identically to the replicated layout AND the
+    single-shard kernel, while evaluating ~1/n_dev the per-device
+    pairs (the structural FLOP proxy, not wall-clock — forced-host
+    virtual devices share cores)."""
+    from sbeacon_tpu.ops.kernel import (
+        DeviceIndex,
+        QuerySpec,
+        encode_queries,
+        run_queries,
+    )
+
+    shards = _shards(5, chrom="7")
+    mfi = MeshFusedIndex(shards, make_mesh())
+    specs = [
+        QuerySpec("7", 1, 1 << 30, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("7", 1500, 2500, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("7", 900, 1600, 1, 1 << 30, alternate_bases="N"),
+    ]
+    pairs = [(sp, sid) for sp in specs for sid in range(5)]
+    enc = encode_queries(
+        [sp for sp, _ in pairs], shard_ids=[sid for _, sid in pairs]
+    )
+    e0 = mesh_mod.N_EVALUATED_PAIRS
+    res_s = mfi.run_mesh_queries(
+        dict(enc), window_cap=2048, record_cap=64, slice_batch=True
+    )
+    sliced_pairs = mesh_mod.N_EVALUATED_PAIRS - e0
+    e0 = mesh_mod.N_EVALUATED_PAIRS
+    res_r = mfi.run_mesh_queries(
+        dict(enc), window_cap=2048, record_cap=64, slice_batch=False
+    )
+    repl_pairs = mesh_mod.N_EVALUATED_PAIRS - e0
+    for name in (
+        "exists",
+        "call_count",
+        "n_variants",
+        "all_alleles_count",
+        "n_matched",
+        "overflow",
+        "rows",
+    ):
+        assert np.array_equal(
+            getattr(res_s, name), getattr(res_r, name)
+        ), name
+    for i, (spec, sid) in enumerate(pairs):
+        ref = run_queries(
+            DeviceIndex(shards[sid]), [spec], window_cap=2048, record_cap=64
+        )
+        assert res_s.call_count[i] == ref.call_count[0]
+        assert np.array_equal(
+            res_s.rows[i][res_s.rows[i] >= 0],
+            ref.rows[0][ref.rows[0] >= 0],
+        )
+    # the structural win: replicated evaluates the full padded batch on
+    # every device; sliced evaluates each device's own slice only
+    assert sliced_pairs * 2 <= repl_pairs, (sliced_pairs, repl_pairs)
+
+
+@multi_device
+def test_tier_refusal_reasons_are_counted():
+    """mesh.refusals{reason}: operators must be able to see WHY
+    traffic falls off the tier — unbuilt, min_shards, planes (a shape
+    the stack cannot serve), stale after a base publish."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        tier = dist.mesh_tier
+        ds = [s.meta["dataset_id"] for s in shards]
+        assert tier.resolve(ds, _payload(ds)) == set()  # nothing built
+        assert tier.stats()["refusals"].get("unbuilt", 0) >= 1
+        assert dist.warmup() > 0
+        assert tier.resolve(["d0"], _payload(["d0"])) == set()
+        assert tier.stats()["refusals"].get("min_shards", 0) == 1
+        # an N inside the ref needs host regex semantics for the
+        # selected-samples leaf: the plane path must refuse
+        pay = _payload(
+            ds,
+            "record",
+            "ALL",
+            selected_samples_only=True,
+            sample_names={d: ["S0"] for d in ds},
+            reference_bases="AN",
+        )
+        assert tier.resolve(ds, pay) == set()
+        assert tier.stats()["refusals"].get("planes", 0) == 1
+        # base publish: the very next consult sees a stale stack
+        eng.add_index(
+            build_index(
+                random_records(
+                    random.Random(123), chrom="1", n=80, n_samples=2
+                ),
+                dataset_id="late2",
+                vcf_location="late2.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+        assert tier.resolve(ds, _payload(ds)) == set()
+        assert tier.stats()["refusals"].get("stale", 0) >= 1
+        # the series rides dispatch_stats -> register_dispatch_metrics
+        assert dist.dispatch_stats()["mesh_refusals"].get("unbuilt", 0) >= 1
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+def test_tier_plane_stack_counts_against_engine_budget():
+    """Bidirectional HBM accounting: the tier's standing plane stack
+    registers in the engine's plane reservation ledger, so a
+    post-build per-dataset upload gate sees it and cannot overcommit
+    the device by the stack's size."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    dist = DistributedEngine([], local=eng)
+    try:
+        before = eng.plane_hbm_resident()
+        dist.warmup()
+        tier = dist.mesh_tier
+        assert tier.stats()["planes"] is True
+        stack_bytes = tier._state[0].plane_bytes_device
+        assert stack_bytes > 0
+        assert eng.plane_hbm_resident() >= before + stack_bytes
+    finally:
+        dist.close()
+        eng.close()
+
+
+@multi_device
+def test_tier_plane_parity_suite():
+    """Per-granularity parity of the tier's with_planes single-launch
+    path against the per-dataset VariantEngine answers, across
+    selected-samples and sample-extraction shapes."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    eng_ref = _engine(_shards(), microbatch=False, mesh_dispatch=False)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        assert dist.mesh_tier.stats()["planes"] is True
+        ds = [s.meta["dataset_id"] for s in shards]
+        for gran in ("boolean", "count", "record"):
+            for mode in ("selected", "extract"):
+                kw = (
+                    dict(
+                        selected_samples_only=True,
+                        sample_names={d: ["S1"] for d in ds},
+                    )
+                    if mode == "selected"
+                    else dict(include_samples=True)
+                )
+                pay = _payload(ds, gran, "ALL", **kw)
+                got = dist.search(pay)
+                ref = eng_ref.search(pay)
+                assert [dataclasses.asdict(r) for r in got] == [
+                    dataclasses.asdict(r) for r in ref
+                ], (gran, mode)
+        # every selected-samples query (and the record/aggregated
+        # extraction) rode the tier, not the per-dataset engine path
+        assert dist.mesh_tier.stats()["dispatches"] >= 4
+    finally:
+        dist.close()
+        eng.close()
+        eng_ref.close()
+
+
+@multi_device
+def test_tier_planes_stay_warm_across_delta_publish():
+    """A delta publish must NOT cold-start the plane-stacked tier: the
+    mesh launch keeps serving base rows, the delta tail host-matches
+    next to it (with the selected-samples mask applied), and a later
+    base publish rebuilds with planes stacked again."""
+    shards = _shards()
+    eng = _engine(shards, microbatch_wait_ms=0.0)
+    eng_ref = _engine(_shards(), microbatch=False, mesh_dispatch=False)
+    dist = DistributedEngine([], local=eng)
+    try:
+        dist.warmup()
+        tier = dist.mesh_tier
+
+        def delta():
+            return build_index(
+                random_records(
+                    random.Random(77), chrom="1", n=40, n_samples=2
+                ),
+                dataset_id="d0",
+                vcf_location="v0",
+                sample_names=["S0", "S1"],
+            )
+
+        eng.add_delta(delta())
+        eng_ref.add_delta(delta())
+        ds = [s.meta["dataset_id"] for s in shards]
+        pay = _payload(
+            ds,
+            "record",
+            "ALL",
+            selected_samples_only=True,
+            sample_names={d: ["S0"] for d in ds},
+        )
+        got = dist.search(pay)
+        ref = eng_ref.search(pay)
+        assert [dataclasses.asdict(r) for r in got] == [
+            dataclasses.asdict(r) for r in ref
+        ]
+        st = tier.stats()
+        assert st["dispatches"] == 1 and st["ready"] and st["planes"]
+        # base publish -> stale -> inline rebuild stacks planes again
+        eng.add_index(
+            build_index(
+                random_records(
+                    random.Random(5), chrom="1", n=60, n_samples=2
+                ),
+                dataset_id="late3",
+                vcf_location="late3.vcf.gz",
+                sample_names=["S0", "S1"],
+            )
+        )
+        assert tier.warmup() > 0
+        assert tier.stats()["planes"] is True
+        assert tier.stats()["shards"] == N_SHARDS + 1
+    finally:
+        dist.close()
+        eng.close()
+        eng_ref.close()
+
+
 # -- MeshDispatchTier through DistributedEngine -------------------------------
 
 
@@ -210,14 +455,17 @@ def test_tier_rides_microbatcher():
 
 
 @multi_device
-def test_tier_plane_shapes_stay_on_engine_paths():
-    """Selected-samples / sample-extraction shapes read genotype planes
-    per dataset — the tier must refuse them and the engine path serve."""
+def test_tier_plane_shapes_ride_the_single_launch():
+    """Selected-samples / sample-extraction shapes now ride the tier's
+    plane-stacked single launch (ISSUE 13) instead of refusing to
+    per-dataset dispatch — with answers identical to the engine path."""
     shards = _shards()
     eng = _engine(shards, microbatch_wait_ms=0.0)
+    eng_ref = _engine(_shards(), microbatch=False, mesh_dispatch=False)
     dist = DistributedEngine([], local=eng)
     try:
         dist.warmup()
+        assert dist.mesh_tier.stats()["planes"] is True
         pay = _payload(
             [s.meta["dataset_id"] for s in shards],
             "record",
@@ -225,12 +473,17 @@ def test_tier_plane_shapes_stay_on_engine_paths():
             include_samples=True,
         )
         got = dist.search(pay)
+        ref = eng_ref.search(pay)
         assert len(got) == N_SHARDS
         assert all(r.sample_names for r in got if r.exists)
-        assert dist.mesh_tier.stats()["dispatches"] == 0
+        assert [dataclasses.asdict(r) for r in got] == [
+            dataclasses.asdict(r) for r in ref
+        ]
+        assert dist.mesh_tier.stats()["dispatches"] == 1
     finally:
         dist.close()
         eng.close()
+        eng_ref.close()
 
 
 @multi_device

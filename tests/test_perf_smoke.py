@@ -365,6 +365,75 @@ def test_mesh_tier_boolean_query_is_one_launch_zero_http():
         eng.close()
 
 
+@pytest.mark.perf_smoke
+def test_mesh_tier_selected_query_is_one_launch_zero_http():
+    """ISSUE 13 acceptance: a selected-samples query over >=2
+    local-device datasets executes as ONE mesh launch (the
+    plane-stacked program — per-query masks reduced on the owning
+    device, zero per-dataset plane dispatches) with ZERO
+    coordinator->worker HTTP calls, byte-identical to the per-dataset
+    path."""
+    import dataclasses
+
+    import jax
+
+    from sbeacon_tpu.parallel import transport as transport_mod
+    from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.testing import random_records
+
+    if len(jax.devices()) < 2:
+        pytest.skip("mesh tier needs >=2 devices (forced-host CI mesh)")
+    eng, _shards = _engine()
+    ref_eng, _ = _engine(mesh_dispatch=False, microbatch=False)
+    weng = VariantEngine(
+        BeaconConfig(engine=EngineConfig(microbatch=False, use_mesh=False))
+    )
+    weng.add_index(
+        build_index(
+            random_records(random.Random(9), chrom="1", n=120, n_samples=2),
+            dataset_id="wrk",
+            vcf_location="wrk.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+    )
+    worker = WorkerServer(weng).start_background()
+    dist = DistributedEngine([worker.address], local=eng)
+
+    def transport_snapshot() -> dict:
+        keys = ("opened", "reused", "evicted", "retried", "gzip_bodies")
+        return {k: transport_mod._STATS.get(k) for k in keys}
+
+    datasets = [f"d{d}" for d in range(N_SHARDS)]
+    pay = dataclasses.replace(
+        _worker_payload(granularity="record", include="ALL",
+                        datasets=datasets),
+        selected_samples_only=True,
+        sample_names={d: ["S1"] for d in datasets},
+    )
+    try:
+        dist.replica_table()  # discovery rides HTTP, OUTSIDE the probe
+        dist.warmup()  # compiles outside the measured window
+        assert dist.mesh_tier.stats()["planes"] is True
+        t0 = transport_snapshot()
+        n0 = _launches()
+        got = dist.search(pay)
+        assert _launches() - n0 == 1, "expected exactly one mesh launch"
+        assert transport_snapshot() == t0, "plane query touched the transport"
+        st = dist.mesh_tier.stats()
+        assert st["dispatches"] == 1 and st["fallbacks"] == 0
+        ref = ref_eng.search(pay)
+        assert [dataclasses.asdict(r) for r in got] == [
+            dataclasses.asdict(r) for r in ref
+        ]
+    finally:
+        dist.close()
+        worker.shutdown()
+        weng.close()
+        ref_eng.close()
+        eng.close()
+
+
 # -- observability stays off the hot path (ISSUE 7) ---------------------------
 
 

@@ -207,6 +207,7 @@ GOLDEN_METRICS = [
     "mesh.dispatches",
     "mesh.fallbacks",
     "mesh.gather_rows",
+    "mesh.refusals",
     "breaker.state",
     "breaker.consecutive_failures",
     "breaker.opens",
