@@ -2955,6 +2955,167 @@ def config16_fleet():
             w2.shutdown()
 
 
+def config18_device():
+    """Device-plane flight recorder probe (ISSUE 14): launch
+    decomposition + padding waste per program family under a
+    config12-style mixed interactive/bulk load, with the
+    /device/status snapshot embedded in the record. The padding-waste
+    ratio is the structural metric the ROADMAP item 1
+    owner-sharded-output follow-up will be judged against, and
+    mid_request_compiles == 0 is the warmup-coverage contract under
+    real concurrency."""
+    import random as _random
+    import tempfile
+    import threading
+    from pathlib import Path
+
+    import sbeacon_tpu.telemetry as _tel
+    from sbeacon_tpu.api import BeaconApp
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        ObservabilityConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.testing import random_records
+
+    # a fresh recorder so the record shows THIS probe's launches, not
+    # the whole bench run's (the process global accumulates). The app
+    # re-applies ObservabilityConfig.device_ring_size to it, so the
+    # 512-entry ring must ALSO ride the config or the constructor
+    # would shrink it back to the 256 default.
+    rec = _tel.DeviceFlightRecorder(ring_size=512)
+    old_tel = _tel.flight_recorder
+    _tel.flight_recorder = rec
+    try:
+        tmp_kw = {"prefix": "bench-device-"}
+        if Path("/dev/shm").is_dir():
+            tmp_kw["dir"] = "/dev/shm"
+        with tempfile.TemporaryDirectory(**tmp_kw) as td:
+            cfg = BeaconConfig(
+                storage=StorageConfig(root=Path(td)),
+                engine=EngineConfig(
+                    use_mesh=False, microbatch_wait_ms=1.0
+                ),
+                observability=ObservabilityConfig(
+                    device_ring_size=512
+                ),
+            )
+            cfg.storage.ensure()
+            app = BeaconApp(cfg)
+            rng = _random.Random(1800)
+            all_pos: list[int] = []
+            for d in range(4):
+                recs = random_records(
+                    rng, chrom="1", n=2000, n_samples=2
+                )
+                all_pos.extend(int(r.pos) for r in recs[:64])
+                app.engine.add_index(
+                    build_index(
+                        recs,
+                        dataset_id=f"dv{d}",
+                        vcf_location=f"dv{d}.vcf.gz",
+                        sample_names=["S0", "S1"],
+                    )
+                )
+            app.store.upsert(
+                "datasets",
+                [
+                    {
+                        "id": f"dv{d}",
+                        "name": f"dv{d}",
+                        "_assemblyId": "GRCh38",
+                        "_vcfLocations": [f"synthetic://dv{d}"],
+                    }
+                    for d in range(4)
+                ],
+            )
+            app.engine.warmup()
+            warmup_programs = rec.compile_snapshot()["programs"]
+
+            def query(k: int, granularity: str) -> dict:
+                p = all_pos[k % len(all_pos)]
+                return {
+                    "query": {
+                        "requestedGranularity": granularity,
+                        "requestParameters": {
+                            "assemblyId": "GRCh38",
+                            "referenceName": "1",
+                            "start": [max(0, p - 1)],
+                            "end": [p + 1 + (k % 7)],
+                            "alternateBases": "N",
+                        },
+                    }
+                }
+
+            # config12-style mix: interactive boolean hot keys (cache
+            # hits after the first pass) racing bulk count tenants
+            # whose distinct coordinates each pay a real launch
+            counts = {"ok": 0, "err": 0}
+            lock = threading.Lock()
+
+            def worker(tid: int) -> None:
+                bulk = tid % 2 == 1
+                for k in range(30):
+                    key = 7000 + tid * 977 + k if bulk else k % 16
+                    s, _b = app.handle(
+                        "POST",
+                        "/g_variants",
+                        body=query(key, "count" if bulk else "boolean"),
+                        headers={
+                            "X-Beacon-Tenant": "bulk" if bulk else "hot"
+                        },
+                    )
+                    with lock:
+                        counts["ok" if s == 200 else "err"] += 1
+
+            threads = [
+                threading.Thread(target=worker, args=(i,), daemon=True)
+                for i in range(6)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(120)
+            status, doc = app.handle("GET", "/device/status")
+            assert status == 200
+            # drain the async runner before closing (a late job
+            # completion must not race the closed job table)
+            import time as _time
+
+            t_end = _time.time() + 10
+            while _time.time() < t_end:
+                if app.query_runner.metrics()["active"] == 0:
+                    break
+                _time.sleep(0.05)
+            app.close()
+            app.engine.close()
+            # embed the snapshot with the ring trimmed: the record
+            # must stay log-tail-parseable (VERDICT r5 rule)
+            doc["ring"]["entries"] = doc["ring"]["entries"][-12:]
+            doc["compiles"]["entries"] = doc["compiles"]["entries"][-12:]
+            return {
+                "requests": counts["ok"],
+                "errors": counts["err"],
+                "warmup_programs": warmup_programs,
+                "launches_by_family": doc["byFamily"],
+                "pad_waste_by_family": doc["padWaste"]["byFamily"],
+                "worst_pad_waste": doc["padWaste"]["worst"],
+                "evaluated_pairs": doc["evaluatedPairs"],
+                "mid_request_compiles": doc["compiles"][
+                    "midRequestCompiles"
+                ],
+                "zero_mid_request_compiles": doc["compiles"][
+                    "midRequestCompiles"
+                ]
+                == 0,
+                "device_status": doc,
+            }
+    finally:
+        _tel.flight_recorder = old_tel
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -3091,6 +3252,7 @@ def main() -> None:
     run("config15_cost", 45, config15_cost)
     run("config16_fleet", 45, config16_fleet)
     run("config17_mesh_slice", 120, config17_mesh_slice)
+    run("config18_device", 40, config18_device)
     emit(final=True)
 
 

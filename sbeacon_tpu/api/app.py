@@ -50,6 +50,7 @@ from ..slo import (
     PROBE_HEAD_LABELS,
     SloEngine,
 )
+from .. import telemetry as telemetry_mod
 from ..telemetry import (
     MetricsRegistry,
     RequestContext,
@@ -58,6 +59,7 @@ from ..telemetry import (
     current_context,
     journal,
     profiler,
+    register_device_metrics,
     request_context,
     sanitize_trace_id,
 )
@@ -306,6 +308,16 @@ class BeaconApp:
             keep=getattr(obs, "event_journal_size", 1024),
             enabled=getattr(obs, "event_journal", True),
         )
+        # device-plane flight recorder (ISSUE 14): same config-tier
+        # re-application as the journal — the process global was built
+        # from BEACON_DEVICE_RING_SIZE / BEACON_COMPILE_TRACKING env
+        # defaults at import. Resolved through the module at call time
+        # (never bound by value here), so a test or bench that swaps
+        # telemetry.flight_recorder swaps this app's view too.
+        telemetry_mod.flight_recorder.configure(
+            ring_size=getattr(obs, "device_ring_size", 256),
+            compile_tracking=getattr(obs, "compile_tracking", True),
+        )
         if obs.profile_dir:
             # config-armed profiling (the env var SBEACON_PROFILE sets
             # the same field at import); first profiled region starts
@@ -389,6 +401,11 @@ class BeaconApp:
             "control-plane events published to the flight recorder",
             fn=journal.published,
         )
+        if "device.launches" not in reg.names():
+            # device-plane flight recorder series (ISSUE 14): the
+            # recorder is process-global, so the usual app fallback
+            # registration keeps a second app from double-registering
+            register_device_metrics(reg)
         self.canary.register_metrics(reg)
         register_admission_metrics(reg, lambda: self.admission)
         self.shaping.register_metrics(reg)
@@ -733,6 +750,8 @@ class BeaconApp:
             return 200, self._fleet_status()
         if head == "debug/status":
             return 200, self._debug_status()
+        if head == "device/status":
+            return 200, self._device_status()
         # /metrics: content negotiation — ?format=openmetrics or an
         # ``Accept: application/openmetrics-text`` (what a modern
         # Prometheus scrape sends first) gets the OpenMetrics dialect
@@ -918,6 +937,19 @@ class BeaconApp:
         # a mismatch here means the data plane is SILENTLY WRONG, the
         # one failure mode no latency or availability signal shows
         canary = self.canary.status()
+        # device-plane rollup (ISSUE 14): launch decomposition +
+        # padding waste + the mid-request compile count, so the
+        # diagnosis can name a device-side regression (a novel batch
+        # shape paying its XLA compile inside a request, or a family
+        # whose padding wastes most of its launches) next to the
+        # breached SLOs it explains
+        recorder = telemetry_mod.flight_recorder
+        device = {
+            "launches": recorder.launch_summary(),
+            "padWaste": recorder.pad_waste_by_family(),
+            "midRequestCompiles": recorder.mid_request_compiles(),
+        }
+        last_compile = recorder.last_mid_request_compile()
         return {
             "ready": bool(self.ready),
             "beaconId": self.config.info.beacon_id,
@@ -929,6 +961,7 @@ class BeaconApp:
             "stages": stages,
             "costs": costs,
             "canary": canary,
+            "device": device,
             "events": {
                 "lastSeq": journal.last_seq(),
                 "published": journal.published(),
@@ -947,8 +980,49 @@ class BeaconApp:
                 "costliestTenant": costs.get("costliestTenant"),
                 "costliestShape": costs.get("costliestShape"),
                 "canaryMismatches": list(canary.get("mismatched", [])),
+                "worstPadWaste": recorder.worst_pad_waste(),
+                "midRequestCompiles": device["midRequestCompiles"],
+                "lastMidRequestCompile": (
+                    last_compile["key"] if last_compile else None
+                ),
             },
         }
+
+    def _device_status(self) -> dict:
+        """The device-plane flight recorder's read surface (ISSUE 14):
+        the launch ring summary (padding waste by family/tier,
+        evaluated pairs, per-launch records), the compile cache vs the
+        warmup shape set, the HBM plane ledger, and the fused/mesh
+        stack states. Every piece is a lock-free snapshot (the
+        recorder's own short lock, try-lock on the engine ledger) —
+        this surface must answer DURING an in-flight stack rebuild,
+        the same discipline as ``/ops/digest``."""
+        engine = self.engine
+        local = getattr(engine, "local", None) or engine
+        doc = telemetry_mod.flight_recorder.snapshot()
+        ledger = getattr(local, "plane_ledger", None)
+        doc["hbm"] = (
+            ledger()
+            if callable(ledger)
+            else {
+                "residentBytes": 0,
+                "reservedBytes": 0,
+                "reservedTokens": 0,
+                "budgetBytes": 0,
+                "headroomBytes": 0,
+                "stale": False,
+            }
+        )
+        stacks: dict = {}
+        fused = getattr(local, "fused_stack_status", None)
+        if callable(fused):
+            stacks["fused"] = fused()
+        tier = getattr(engine, "mesh_tier", None)
+        if tier is not None:
+            stacks["meshTier"] = tier.stats()
+        doc["stacks"] = stacks
+        doc["time"] = time.time()
+        return doc
 
     def _metrics(self) -> dict:
         """Serving observability: the typed-instrument registry rendered

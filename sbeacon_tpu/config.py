@@ -451,6 +451,15 @@ class ObservabilityConfig:
       (explicit ``run_once()`` still works).
     canary_latency_ms: a correct probe slower than this ticks
       ``canary.slow_probes``.
+
+    Device-plane flight recorder (telemetry.DeviceFlightRecorder,
+    served at ``/device/status``; ISSUE 14):
+    device_ring_size: per-launch records kept in the bounded launch
+      ring (``BEACON_DEVICE_RING_SIZE``).
+    compile_tracking: track first-seen (program, shape) compile keys;
+      a compile outside warmup emits a ``device.compile`` journal
+      event and ticks ``device.mid_request_compiles``
+      (``BEACON_COMPILE_TRACKING``).
     """
 
     slow_query_ms: float = 1000.0
@@ -469,6 +478,8 @@ class ObservabilityConfig:
     canary_enabled: bool = True
     canary_interval_s: float = 30.0
     canary_latency_ms: float = 1000.0
+    device_ring_size: int = 256
+    compile_tracking: bool = True
 
 
 @dataclasses.dataclass(frozen=True)
@@ -688,6 +699,7 @@ class BeaconConfig:
             ),
             "BEACON_CANARY_INTERVAL_S": ("canary_interval_s", float),
             "BEACON_CANARY_LATENCY_MS": ("canary_latency_ms", float),
+            "BEACON_DEVICE_RING_SIZE": ("device_ring_size", int),
         }
         for var, (field, conv) in _obs_env.items():
             if var in env:
@@ -699,6 +711,10 @@ class BeaconConfig:
         if "BEACON_CANARY_ENABLED" in env:
             obs_over["canary_enabled"] = (
                 env["BEACON_CANARY_ENABLED"].lower() not in _off
+            )
+        if "BEACON_COMPILE_TRACKING" in env:
+            obs_over["compile_tracking"] = (
+                env["BEACON_COMPILE_TRACKING"].lower() not in _off
             )
         if "BEACON_COST_ACCOUNTING" in env:
             obs_over["cost_accounting"] = (

@@ -47,6 +47,7 @@ from .telemetry import (
     annotate,
     charge_cost,
     current_context,
+    device_warmup_phase,
     percentiles,
     publish_event,
     request_context,
@@ -745,6 +746,17 @@ class VariantEngine:
         # key -> bytes reserved for an in-flight plane upload (counts
         # against plane_hbm_budget_gb until the planes are published)
         self._plane_reserved: dict = {}
+        # last computed HBM-ledger snapshot: /device/status reads it
+        # when the publish lock is busy (a rebuild can hold _mesh_lock
+        # for seconds, and a status probe must answer anyway)
+        self._plane_ledger_cache: dict = {
+            "residentBytes": 0,
+            "reservedBytes": 0,
+            "reservedTokens": 0,
+        }
+        # wall time the current fused stack was published (stack age
+        # on the /device/status stacks surface)
+        self._fused_built_at: float | None = None
         # cached index-set identity, recomputed under _mesh_lock at
         # every publish: the query hot path (cache keys, async-job
         # fingerprints) reads it per request, so it must be O(1) and
@@ -1125,7 +1137,16 @@ class VariantEngine:
         shapes x fused-planes) so no request ever pays a first-compile
         (the BENCH_r04 soak tail attribution; VERDICT r4 next #7).
         Returns the number of programs touched. Call after (re-)ingest
-        or at server start; cached signatures make repeats near-free."""
+        or at server start; cached signatures make repeats near-free.
+
+        Runs inside a flight-recorder warmup phase (ISSUE 14): the
+        compile tracker stamps these (program, shape) keys as EXPECTED,
+        so only a shape first compiled outside warmup ticks
+        ``device.mid_request_compiles``."""
+        with device_warmup_phase():
+            return self._warmup()
+
+    def _warmup(self) -> int:
         from .ops.scatter_kernel import ScatterDeviceIndex, warmup_index
 
         eng = self.config.engine
@@ -1287,6 +1308,65 @@ class VariantEngine:
         same accounting ``_mesh_ready``'s own gate applies."""
         with self._mesh_lock:
             return self._plane_hbm_resident_locked()
+
+    def plane_ledger(self) -> dict:
+        """The HBM plane-budget ledger as a LOCK-FREE snapshot (the
+        ``/device/status`` surface, ISSUE 14): resident per-dataset
+        plane bytes, standing reservations (in-flight uploads + the
+        mesh tier's stacked planes) with their token count, and the
+        budget headroom. The publish lock is only TRIED — when a stack
+        rebuild holds it, the last computed snapshot serves with
+        ``stale: true`` (the same answer-while-rebuilding discipline
+        as ``/ops/digest``)."""
+        budget = (
+            getattr(self.config.engine, "plane_hbm_budget_gb", 11.0)
+            * 1e9
+        )
+        got = self._mesh_lock.acquire(blocking=False)
+        if got:
+            try:
+                self._plane_ledger_cache = {
+                    "residentBytes": int(
+                        sum(
+                            p.nbytes_hbm()
+                            for _s, _d, p in self._indexes.values()
+                            if p is not None
+                        )
+                    ),
+                    "reservedBytes": int(
+                        sum(self._plane_reserved.values())
+                    ),
+                    "reservedTokens": len(self._plane_reserved),
+                }
+            finally:
+                self._mesh_lock.release()
+        out = dict(self._plane_ledger_cache)
+        out["budgetBytes"] = int(budget)
+        out["headroomBytes"] = int(
+            budget - out["residentBytes"] - out["reservedBytes"]
+        )
+        out["stale"] = not got
+        return out
+
+    def fused_stack_status(self) -> dict:
+        """The fused cross-shard stack's state, lock-free (GIL-atomic
+        reference reads — never the publish lock a rebuild may hold):
+        built/dirty flags, fingerprint, age, and the stacked shape."""
+        state = self._fused_state
+        built_at = self._fused_built_at
+        doc: dict = {
+            "built": state is not None,
+            "dirty": bool(self._fused_dirty),
+            "fingerprint": self._base_fingerprint,
+        }
+        if state is not None:
+            findex = state[0]
+            doc["shards"] = findex.n_shards
+            doc["rows"] = findex.n_rows
+            doc["paddedRows"] = findex.n_padded
+        if built_at is not None:
+            doc["ageS"] = round(time.time() - built_at, 1)
+        return doc
 
     def register_plane_bytes(self, token, nbytes: int) -> None:
         """Account an EXTERNAL standing plane allocation (the mesh
@@ -1672,6 +1752,7 @@ class VariantEngine:
                 # stale — drop it; the next query rebuilds fresh
                 return None
             self._fused_state = state
+            self._fused_built_at = time.time()
         publish_event(
             "engine.fused_rebuild", shards=len(keys), rows=total
         )

@@ -26,6 +26,7 @@ Design notes (TPU/XLA):
 from __future__ import annotations
 
 import math
+import time
 from dataclasses import dataclass
 from functools import partial
 
@@ -41,8 +42,9 @@ from ..index.columnar import (
     pack_prefix16,
     prefix_mask,
 )
+from ..telemetry import note_device_stage, record_device_launch
 from ..utils.chrom import chromosome_code
-from ..utils.trace import span
+from ..utils.trace import graft_launch_span, span
 
 # variant_type codes for the type-dispatch mode
 VT_DEL, VT_INS, VT_DUP, VT_DUP_TANDEM, VT_CNV, VT_OTHER = range(6)
@@ -57,11 +59,23 @@ _VT_CODES = {
 # alt matching modes
 MODE_EXACT, MODE_ANY_BASE, MODE_TYPE = range(3)
 
-# device launches issued by this module (one per jitted query-batch
-# dispatch) — the perf_smoke evidence that fused dispatch and the
-# response cache actually collapse launches; scatter_kernel keeps its
-# own N_DISPATCHES for the TPU tile kernels
-N_LAUNCHES = 0
+def __getattr__(name: str):
+    """Module back-compat properties (PEP 562): ``N_LAUNCHES`` — one
+    per jitted query-batch dispatch, the perf_smoke evidence that
+    fused dispatch and the response cache actually collapse launches —
+    now reads the device flight recorder (telemetry.py). The old
+    module-global ``N_LAUNCHES += 1`` was an unlocked read-modify-write
+    racing across request threads on real accelerators; the recorder's
+    lock owns the increment, and the name stays readable here.
+    ``tools/check_launch_recording.py`` rejects any reintroduced
+    direct counter assignment."""
+    if name == "N_LAUNCHES":
+        from ..telemetry import flight_recorder
+
+        return flight_recorder.kernel_launches
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
 
 
 @dataclass
@@ -449,14 +463,22 @@ class PendingQueryResults:
     with the device execution of batch i instead of blocking the
     launcher thread inside ``device_get``."""
 
-    __slots__ = ("_out", "_b")
+    __slots__ = ("_out", "_b", "flight_seq")
 
-    def __init__(self, out, b: int):
+    def __init__(self, out, b: int, flight_seq: int | None = None):
         self._out = out
         self._b = b
+        #: the launch's flight-recorder record: fetch attaches its
+        #: device-readback wall time there (serving's launch/fetch
+        #: stages run on different threads, so the seq is the handle)
+        self.flight_seq = flight_seq
 
     def fetch(self) -> QueryResults:
+        t0 = time.perf_counter()
         out = jax.device_get(self._out)
+        note_device_stage(
+            self.flight_seq, fetch_ms=(time.perf_counter() - t0) * 1e3
+        )
         self._out = None  # free the device buffers promptly
         b = self._b
         extra = {
@@ -512,7 +534,6 @@ def run_queries(
     after dispatch (launch/fetch overlap); default blocks and returns
     :class:`QueryResults`.
     """
-    global N_LAUNCHES
     enc = (
         encode_queries(queries) if isinstance(queries, list) else queries
     )
@@ -525,7 +546,9 @@ def run_queries(
             )
             for k, v in enc.items()
         }
+    padded = tier if (b and tier) else b
     with span("kernel.run_queries") as sp:
+        t0 = time.perf_counter()
         enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
         out = _query_batch(
             dindex.arrays,
@@ -534,9 +557,40 @@ def run_queries(
             record_cap=record_cap,
             n_iters=dindex.n_iters,
         )
-        N_LAUNCHES += 1
+        launch_ms = (time.perf_counter() - t0) * 1e3
+        # ONE flight-recorder seam per launch: counters, the launch
+        # ring, and compile tracking (a first-seen (program, shape)
+        # key below is an XLA compile — jit traces inside this call)
+        seq = record_device_launch(
+            "fused",
+            seam="kernel",
+            tier=padded,
+            specs_real=b,
+            specs_padded=padded,
+            launch_ms=launch_ms,
+            program_key=(
+                "xla_gather",
+                type(dindex).__name__,
+                dindex.n_padded,
+                # a fused stack rebuild can keep n_padded while its
+                # [k, 27] segment table grows a row — a distinct XLA
+                # program, so the shard count is part of the identity
+                getattr(dindex, "n_shards", 1),
+                dindex.n_iters,
+                padded,
+                window_cap,
+                record_cap,
+            ),
+        )
         sp.note(batch=b)
-    pending = PendingQueryResults(out, b)
+        graft_launch_span(
+            sp,
+            elapsed_ms=launch_ms,
+            family="fused",
+            tier=padded,
+            specs=b,
+        )
+    pending = PendingQueryResults(out, b, seq)
     if async_fetch:
         return pending
     return pending.fetch()

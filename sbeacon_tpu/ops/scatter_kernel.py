@@ -57,6 +57,7 @@ unpacks row ids with one vectorised ``np.unpackbits`` per batch.
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -64,6 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..index.columnar import FLAG, INT32_MAX, VariantIndexShard
+from ..telemetry import note_device_stage, record_device_launch
 from .kernel import (
     MODE_ANY_BASE,
     MODE_EXACT,
@@ -116,11 +118,21 @@ CHUNK_SMALL = 64
 # first-match form handles; longer records take the segmented-scan form
 SEG_K_MAX = 8
 
-# device-dispatch accounting: incremented once per kernel program
-# launched (a multi-chunk _scatter_many lax.map is ONE dispatch). The
-# bench divides deltas by request count to evidence the one-dispatch-
-# per-request-batch serving contract (VERDICT r3 #4).
-N_DISPATCHES = 0
+def __getattr__(name: str):
+    """Module back-compat property (PEP 562): ``N_DISPATCHES`` — one
+    per kernel program launched (a multi-chunk _scatter_many lax.map
+    is ONE dispatch; the bench divides deltas by request count to
+    evidence the one-dispatch-per-request-batch serving contract,
+    VERDICT r3 #4) — now served by the device flight recorder
+    (telemetry.py), whose lock owns the increment instead of the old
+    unlocked module-global read-modify-write."""
+    if name == "N_DISPATCHES":
+        from ..telemetry import flight_recorder
+
+        return flight_recorder.scatter_dispatches
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
 
 
 class ScatterDeviceIndex:
@@ -647,7 +659,6 @@ def run_selected_scattered(
     pc_tok = np.zeros((b, R_top), np.int32)
     or_words = np.zeros((b, W), np.uint32)
     is_exact = enc["alt_mode"] == MODE_EXACT
-    global N_DISPATCHES
     for ti, cap in [(-1, T)] + list(enumerate(caps)):
         in_tier = tier_of == ti
         R = min(record_cap, cap)
@@ -677,7 +688,7 @@ def run_selected_scattered(
                         np.zeros((pad, W), np.uint32),
                     ]
                 )
-                N_DISPATCHES += 1
+                t0 = time.perf_counter()
                 a, r, pc, pt, ow = _selected_batch(
                     sindex.tiles,
                     pindex.gt,
@@ -696,7 +707,30 @@ def run_selected_scattered(
                     with_counts=with_counts,
                     seg_k=_static_seg_k(sindex),
                 )
+                seq = record_device_launch(
+                    "plane",
+                    seam="scatter",
+                    tier=nslots,
+                    specs_real=bb,
+                    specs_padded=nslots,
+                    launch_ms=(time.perf_counter() - t0) * 1e3,
+                    program_key=(
+                        # tile count and plane shapes are argument
+                        # shapes: another dataset's planes compile a
+                        # fresh program even at the same slot count
+                        "scatter_selected",
+                        int(sindex.tiles.shape[0]),
+                        tuple(int(d) for d in pindex.gt.shape),
+                        W, nslots, cap, R,
+                        1 if ti == -1 else None, exact, with_counts,
+                        _static_seg_k(sindex), T,
+                    ),
+                )
+                t0 = time.perf_counter()
                 a, r, pc, pt, ow = jax.device_get((a, r, pc, pt, ow))
+                note_device_stage(
+                    seq, fetch_ms=(time.perf_counter() - t0) * 1e3
+                )
                 agg[ss] = np.asarray(a)[:bb]
                 rows[ss, :R] = np.asarray(r)[:bb]
                 pc_call[ss, :R] = np.asarray(pc)[:bb]
@@ -850,9 +884,8 @@ def _launch_tier(sindex, tile_ids, q8, *, cap, C=None, exact_only=False):
         q8 = np.concatenate([q8, np.zeros((pad, 8), np.int32)])
     nc = len(tile_ids) // nslots
     T = sindex.tile
-    global N_DISPATCHES
-    N_DISPATCHES += 1
     seg_k = _static_seg_k(sindex)
+    t0 = time.perf_counter()
     if nc == 1:
         agg, masks = _scatter_batch(
             sindex.tiles,
@@ -879,7 +912,21 @@ def _launch_tier(sindex, tile_ids, q8, *, cap, C=None, exact_only=False):
         )
         agg = agg.reshape(nc * nslots, 8)
         masks = masks.reshape(nc * nslots, -1)
-    return agg, masks
+    seq = record_device_launch(
+        "scatter",
+        seam="scatter",
+        tier=nslots,
+        specs_real=b,
+        specs_padded=nc * nslots,
+        launch_ms=(time.perf_counter() - t0) * 1e3,
+        program_key=(
+            # tiles is an argument array: a different tile count is a
+            # different compiled program, so it joins the identity
+            "scatter", int(sindex.tiles.shape[0]), nslots, nc, cap, C,
+            exact_only, seg_k, T,
+        ),
+    )
+    return agg, masks, seq
 
 
 
@@ -952,7 +999,7 @@ def run_queries_scattered(
             sel = np.flatnonzero(in_tier & (is_exact == exact))
             if not len(sel):
                 continue
-            a_dev, m_dev = _launch_tier(
+            a_dev, m_dev, seq = _launch_tier(
                 sindex,
                 tile_ids_all[sel],
                 q8[sel],
@@ -960,18 +1007,27 @@ def run_queries_scattered(
                 C=1 if ti == -1 else None,
                 exact_only=exact,
             )
-            launched.append((sel, a_dev, m_dev))
+            launched.append((sel, a_dev, m_dev, seq))
     if launched:
+        t_fetch = time.perf_counter()
         if with_rows:
             fetched = jax.device_get(
-                [(a, m) for _s, a, m in launched]
+                [(a, m) for _s, a, m, _q in launched]
             )
         else:
             fetched = [
                 (a, None)
-                for a in jax.device_get([a for _s, a, _m in launched])
+                for a in jax.device_get(
+                    [a for _s, a, _m, _q in launched]
+                )
             ]
-        for (sel, _ad, _md), (a, masks) in zip(launched, fetched):
+        # ONE combined readback returns every tier's handles together:
+        # its wall time is each launch's fetch stage (they complete as
+        # a unit), so every record in the batch carries it
+        fetch_ms = (time.perf_counter() - t_fetch) * 1e3
+        for _sel, _ad, _md, seq in launched:
+            note_device_stage(seq, fetch_ms=fetch_ms)
+        for (sel, _ad, _md, _q), (a, masks) in zip(launched, fetched):
             agg[sel] = np.asarray(a)[: len(sel)]
             if with_rows:
                 base_rows = tile_ids_all[sel].astype(np.int64) * T

@@ -77,6 +77,7 @@ from ..telemetry import (
     annotate,
     charge_cost,
     current_context,
+    device_warmup_phase,
     new_span_id,
     publish_event,
     request_context,
@@ -1205,6 +1206,9 @@ class MeshDispatchTier:
         # tier can never leave a phantom plane-byte reservation (or a
         # resurrected state) behind
         self._tier_closed = False
+        # wall time the serving state was published (stack age on the
+        # /device/status stacks surface)
+        self._built_at: float | None = None
 
     # -- availability / build ----------------------------------------------
 
@@ -1373,6 +1377,7 @@ class MeshDispatchTier:
                         reg(self, 0)
                     return None
                 self._state = state
+                self._built_at = time.time()
             # settle the bidirectional budget accounting on the NEW
             # stack alone (keyed on the tier, so this replaces the
             # build-window reservation — and a plane-less rebuild
@@ -1438,7 +1443,13 @@ class MeshDispatchTier:
 
     def warmup(self) -> int:
         """Build inline and pre-compile the tier's batch-tier programs;
-        returns the program count (0 when the tier cannot engage)."""
+        returns the program count (0 when the tier cannot engage).
+        Runs inside a flight-recorder warmup phase so the compile
+        tracker stamps these shapes as expected (ISSUE 14)."""
+        with device_warmup_phase():
+            return self._warmup()
+
+    def _warmup(self) -> int:
         state = self._ready(wait=True)
         if state is None:
             return 0
@@ -1755,6 +1766,7 @@ class MeshDispatchTier:
     def stats(self) -> dict:
         with self._lock:
             state = self._state
+            built_at = self._built_at
             out = {
                 "dispatches": self._dispatches,
                 "fallbacks": self._fallbacks,
@@ -1765,6 +1777,14 @@ class MeshDispatchTier:
         out["shards"] = len(state[1]) if state is not None else 0
         out["devices"] = state[0].n_dev if state is not None else 0
         out["planes"] = bool(state[0].has_planes) if state else False
+        # stack identity + age (the /device/status stacks surface):
+        # which publish this stack serves and how long it has stood
+        out["fingerprint"] = state[4] if state is not None else ""
+        out["ageS"] = (
+            round(time.time() - built_at, 1)
+            if state is not None and built_at is not None
+            else None
+        )
         return out
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import contextlib
 import os
 import threading
+import time
 from functools import partial
 
 import jax
@@ -47,25 +48,37 @@ from ..ops.kernel import (
 
 AXIS = "d"
 
-#: compiled mesh-program dispatches issued by this module (one per
-#: jitted sharded/fused query-batch launch) — the perf_smoke evidence
-#: that the pod tier really is single-launch; kernel.py N_LAUNCHES and
-#: scatter_kernel.N_DISPATCHES count the single-device families
-N_LAUNCHES = 0
 
-#: launches that ran the per-device SLICED batch layout (the encoded
-#: query batch sharded by owning device instead of replicated)
-N_SLICED_LAUNCHES = 0
+def __getattr__(name: str):
+    """Module back-compat properties (PEP 562), served by the device
+    flight recorder (telemetry.py): the old unlocked module-global
+    increments raced across request threads on real accelerators
+    (no ``_CPU_COLLECTIVE_LOCK`` there); the recorder's lock now owns
+    them and these names stay readable for tests and bench.
 
-#: per-device FLOP proxy: evaluated (device, query-slot) pairs summed
-#: over the mesh, per launch — replicated layout evaluates
-#: batch x n_dev pairs (every device runs the full batch masked by
-#: ownership), the sliced layout ~batch total (each device runs only
-#: its slice, padded to a shared tier). bench config17's structural
-#: scaling assert reads this instead of wall-clock (virtual-CPU
-#: honesty rule: forced host devices share cores, so time measures
-#: the serialised emulation, not the pod)
-N_EVALUATED_PAIRS = 0
+    - ``N_LAUNCHES``: compiled mesh-program dispatches (one per jitted
+      sharded/fused query-batch launch) — the perf_smoke evidence that
+      the pod tier really is single-launch; kernel.py N_LAUNCHES and
+      scatter_kernel.N_DISPATCHES count the single-device families.
+    - ``N_SLICED_LAUNCHES``: launches that ran the per-device SLICED
+      batch layout (the encoded batch sharded by owning device).
+    - ``N_EVALUATED_PAIRS``: per-device FLOP proxy — evaluated
+      (device, query-slot) pairs summed over the mesh, per launch
+      (replicated layout evaluates batch x n_dev pairs, the sliced
+      layout ~batch total). bench config17's structural scaling assert
+      reads this instead of wall-clock (virtual-CPU honesty rule).
+    """
+    from ..telemetry import flight_recorder
+
+    if name == "N_LAUNCHES":
+        return flight_recorder.mesh_launches
+    if name == "N_SLICED_LAUNCHES":
+        return flight_recorder.sliced_launches
+    if name == "N_EVALUATED_PAIRS":
+        return flight_recorder.evaluated_pairs
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}"
+    )
 
 
 def _slice_default() -> bool:
@@ -663,15 +676,24 @@ class MeshPendingResults:
     first ``b`` rows). Plane outputs (``pc_call``/``pc_tok``/
     ``or_words``) ride along when the launch ran the plane program."""
 
-    __slots__ = ("_out", "_b", "_pos")
+    __slots__ = ("_out", "_b", "_pos", "flight_seq")
 
-    def __init__(self, out, b: int, positions=None):
+    def __init__(self, out, b: int, positions=None,
+                 flight_seq: int | None = None):
         self._out = out
         self._b = b
         self._pos = positions
+        #: the launch's flight-recorder record (fetch-stage timing)
+        self.flight_seq = flight_seq
 
     def fetch(self) -> QueryResults:
+        from ..telemetry import note_device_stage
+
+        t0 = time.perf_counter()
         out = jax.device_get(self._out)
+        note_device_stage(
+            self.flight_seq, fetch_ms=(time.perf_counter() - t0) * 1e3
+        )
         self._out = None  # free the device buffers promptly
         if self._pos is None:
             sel = lambda a: np.asarray(a)[: self._b]
@@ -990,7 +1012,6 @@ class MeshFusedIndex:
         the full replicated batch masked by ownership. The psum fan-in
         and ring row-gather reassemble, and the inverse permute
         restores caller order at fetch."""
-        global N_LAUNCHES, N_SLICED_LAUNCHES, N_EVALUATED_PAIRS
         if isinstance(queries, list):
             raise ValueError(
                 "MeshFusedIndex batches must carry explicit shard ids "
@@ -1118,9 +1139,16 @@ class MeshFusedIndex:
                 )
             )
             _FN_CACHE[key] = fn
-        from ..utils.trace import span
+        from ..telemetry import record_device_launch
+        from ..utils.trace import graft_launch_span, span
 
+        family = (
+            "plane"
+            if with_planes
+            else ("mesh_sliced" if use_slice else "mesh_replicated")
+        )
         with span("mesh.run_queries") as sp:
+            t0 = time.perf_counter()
             if use_slice:
                 sharding = NamedSharding(self.mesh, P(self.axis))
                 put = lambda v: jax.device_put(jnp.asarray(v), sharding)
@@ -1138,17 +1166,53 @@ class MeshFusedIndex:
                     # fetch (or the next launch) can't overlap this
                     # program's device rendezvous
                     out = jax.block_until_ready(out)
-            N_LAUNCHES += 1
-            if use_slice:
-                N_SLICED_LAUNCHES += 1
-            N_EVALUATED_PAIRS += local_b * self.n_dev
+            launch_ms = (time.perf_counter() - t0) * 1e3
+            # the one flight-recorder seam for every mesh launch:
+            # replicated layouts pad the whole batch to its tier on
+            # every device, sliced layouts pad each device's slice to
+            # the shared slice tier — either way the padded slot count
+            # is local_b x n_dev, the evaluated-pairs FLOP proxy
+            seq = record_device_launch(
+                family,
+                seam="mesh",
+                tier=local_b,
+                specs_real=b,
+                specs_padded=(
+                    local_b * self.n_dev if use_slice else local_b
+                ),
+                evaluated_pairs=local_b * self.n_dev,
+                launch_ms=launch_ms,
+                sliced=use_slice,
+                program_key=(
+                    "mesh",
+                    self.n_dev,
+                    self.d_local,
+                    self.n_iters,
+                    self.n_padded,
+                    self.plane_words if with_planes else 0,
+                    gather_impl,
+                    use_slice,
+                    with_planes,
+                    self.has_count_planes if with_planes else False,
+                    local_b,
+                    window_cap,
+                    record_cap,
+                ),
+            )
             sp.note(
                 batch=b,
                 mesh=self.n_dev,
                 sliced=use_slice,
                 planes=with_planes,
             )
-        pending = MeshPendingResults(out, b, pos)
+            graft_launch_span(
+                sp,
+                elapsed_ms=launch_ms,
+                family=family,
+                tier=local_b,
+                specs=b,
+            )
+        pending = MeshPendingResults(out, b, pos, seq)
         return pending if async_fetch else pending.fetch()
 
 

@@ -52,8 +52,10 @@ from .telemetry import (
     annotate,
     charge_cost_to,
     current_context,
+    note_device_stage,
     percentiles,
     profile_region,
+    request_context,
 )
 from .utils.trace import span
 
@@ -937,8 +939,18 @@ class MicroBatcher:
             charge_cost_to(
                 p.ctx, queue_wait_ms=(t_launch - p.t_submit) * 1e3
             )
+        # the batch leader's request context rides the launch thread
+        # (ambient, like deadlines): the flight recorder stamps launch
+        # records — and a mid-request device.compile journal event —
+        # with the trace id of the request that paid for the launch.
+        # Cost attribution stays per-submission via the explicit ctx.
+        lead_ctx = next(
+            (p.ctx for p in batch if p.ctx is not None), None
+        )
         try:
-            with span("serving.microbatch") as sp, profile_region(
+            with request_context(lead_ctx), span(
+                "serving.microbatch"
+            ) as sp, profile_region(
                 "sbeacon.kernel.launch"
             ):
                 # chaos site: a raised fault takes the existing
@@ -977,6 +989,13 @@ class MicroBatcher:
         with self._stats_lock:
             self._encode_ms.append((t_enc - t_launch) * 1e3)
             self._launch_ms.append((t_disp - t_enc) * 1e3)
+        # the launch's flight-recorder record gets the host encode
+        # stage (the kernel seam only sees pre-encoded arrays; fetch ms
+        # is attached by the pending handle's own fetch)
+        note_device_stage(
+            getattr(pending, "flight_seq", None),
+            encode_ms=(t_enc - t_launch) * 1e3,
+        )
         if stage_hist is not None:
             stage_hist.observe(
                 (t_enc - t_launch) * 1e3, label_value="encode"

@@ -63,6 +63,7 @@ PROBE_ROUTE_LABELS = frozenset({
     "ops.events",
     "ops.costs",
     "debug.status",
+    "device.status",
     "fleet.status",
 })
 

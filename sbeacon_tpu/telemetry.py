@@ -979,6 +979,445 @@ def publish_event(kind: str, **data) -> int | None:
     return journal.publish(kind, **data)
 
 
+# -- device-plane flight recorder ---------------------------------------------
+
+
+#: the compiled-program families the device plane dispatches: the
+#: scatter tile kernels, the XLA gather kernel (single-shard and fused
+#: stacked alike — one program family), the mesh shard_map program in
+#: its replicated and sliced batch layouts, and the genotype-plane
+#: program. Every launch record names exactly one of these.
+DEVICE_FAMILIES = (
+    "scatter",
+    "fused",
+    "mesh_replicated",
+    "mesh_sliced",
+    "plane",
+)
+
+
+class DeviceFlightRecorder:
+    """Per-launch telemetry for every compiled device program — the
+    device-plane twin of the control plane's :class:`EventJournal`
+    (ISSUE 14).
+
+    The reference gets per-invocation visibility for free (every
+    Lambda in its scatter-gather is individually metered by
+    CloudWatch); our replacement for that fan-out — the micro-batcher's
+    compiled launches, the fused stack, the pod-local mesh tier — used
+    to count launches in UNLOCKED module globals (``mesh.N_LAUNCHES``
+    ``+= 1`` raced across request threads on real accelerators, where
+    no ``_CPU_COLLECTIVE_LOCK`` serialises launches) and recorded
+    nothing else. This recorder is the single seam all kernel families
+    report through:
+
+    - a bounded **launch ring**: program family, batch tier,
+      real-vs-padded spec counts (padding-waste ratio), evaluated
+      (device, query) pairs, encode/launch/fetch ms, and the ambient
+      trace id per launch;
+    - lifetime **counters** under one lock (the old module names stay
+      readable as module properties backed by these);
+    - a **compile-event tracker**: the first launch of a novel
+      (program, shape) key is a compile — its wall duration is
+      stamped, and a compile observed OUTSIDE a warmup phase emits a
+      ``device.compile`` journal event and ticks
+      ``device.mid_request_compiles`` (the config9-era "fresh program
+      per novel batch size" soak-tail regression becomes a named,
+      alertable signal instead of a latency mystery).
+
+    Everything is O(1) per launch (one short lock, dict upserts) and
+    every read surface snapshots under the same short lock — never an
+    engine or stack-rebuild lock — so ``/device/status`` answers while
+    a mesh rebuild is in flight.
+    """
+
+    def __init__(self, ring_size: int = 256, *,
+                 compile_tracking: bool = True):
+        self._lock = threading.Lock()
+        self._keep = max(1, int(ring_size))
+        self._ring: "collections.deque[dict]" = collections.deque()
+        self._by_seq: dict[int, dict] = {}
+        self._seq = 0
+        self.compile_tracking = bool(compile_tracking)
+        # lifetime counters: per family, per seam (the module-property
+        # back-compat views), sliced launches, evaluated pairs
+        self._families: dict[str, int] = {}
+        self._seams: dict[str, int] = {}
+        self._sliced = 0
+        self._pairs = 0
+        # padding accounting: family -> [real, padded] spec slots, and
+        # (family, tier) -> [real, padded] for the tier-boundary view
+        self._pad: dict[str, list] = {}
+        self._pad_tier: dict[tuple, list] = {}
+        # compile tracker: first-seen (program, shape) keys
+        self._compiles: dict[str, dict] = {}
+        self._warmup_depth = 0
+        self._mid_request = 0
+        self._last_mid: dict | None = None
+
+    def configure(self, *, ring_size: int | None = None,
+                  compile_tracking: bool | None = None) -> None:
+        """Apply config-tier settings to the process-global recorder
+        (built at import from env defaults, like :data:`journal`)."""
+        with self._lock:
+            if compile_tracking is not None:
+                self.compile_tracking = bool(compile_tracking)
+            if ring_size is not None:
+                self._keep = max(1, int(ring_size))
+                while len(self._ring) > self._keep:
+                    old = self._ring.popleft()
+                    self._by_seq.pop(old["seq"], None)
+
+    @contextmanager
+    def warmup_phase(self):
+        """Mark compiles as EXPECTED while a warmup runs (engine /
+        mesh-tier program pre-compilation). A process-wide depth
+        counter, not a thread-local flag: warmup launches ride the
+        batcher's pool threads, so the compiling thread is not the
+        thread that entered warmup."""
+        with self._lock:
+            self._warmup_depth += 1
+        try:
+            yield
+        finally:
+            with self._lock:
+                self._warmup_depth -= 1
+
+    # -- the one write seam ---------------------------------------------------
+
+    def record_launch(
+        self,
+        family: str,
+        *,
+        seam: str,
+        tier: int,
+        specs_real: int,
+        specs_padded: int,
+        evaluated_pairs: int = 0,
+        launch_ms: float = 0.0,
+        program_key=None,
+        sliced: bool = False,
+    ) -> int:
+        """Record ONE device launch; returns its sequence number (the
+        handle :meth:`note_stage` later attaches encode/fetch timings
+        to). ``seam`` is the dispatching module (``kernel`` / ``mesh``
+        / ``scatter`` — the back-compat module properties read these);
+        ``program_key`` is a hashable (program, shape) identity fed to
+        the compile tracker (None skips tracking for this launch)."""
+        specs_real = int(specs_real)
+        specs_padded = max(int(specs_padded), specs_real, 1)
+        rec: dict = {
+            "family": family,
+            "tier": int(tier),
+            "specs": specs_real,
+            "padded": specs_padded,
+            "padWaste": round(1.0 - specs_real / specs_padded, 4),
+            "evaluatedPairs": int(evaluated_pairs),
+            "launchMs": round(float(launch_ms), 3),
+            "time": time.time(),
+        }
+        if sliced:
+            rec["sliced"] = True
+        ctx = current_context()
+        if ctx is not None:
+            rec["traceId"] = ctx.trace_id
+        compile_evt = None
+        with self._lock:
+            self._seq += 1
+            rec["seq"] = self._seq
+            self._families[family] = self._families.get(family, 0) + 1
+            self._seams[seam] = self._seams.get(seam, 0) + 1
+            if sliced:
+                self._sliced += 1
+            self._pairs += int(evaluated_pairs)
+            pad = self._pad.setdefault(family, [0, 0])
+            pad[0] += specs_real
+            pad[1] += specs_padded
+            ptier = self._pad_tier.setdefault((family, int(tier)), [0, 0])
+            ptier[0] += specs_real
+            ptier[1] += specs_padded
+            if program_key is not None and self.compile_tracking:
+                key = self._key_str(program_key)
+                if key not in self._compiles:
+                    warm = self._warmup_depth > 0
+                    entry = {
+                        "key": key,
+                        "family": family,
+                        "tier": int(tier),
+                        "durationMs": round(float(launch_ms), 3),
+                        "time": rec["time"],
+                        "warmup": warm,
+                    }
+                    self._compiles[key] = entry
+                    rec["compiled"] = True
+                    if not warm:
+                        self._mid_request += 1
+                        self._last_mid = entry
+                        compile_evt = entry
+            self._ring.append(rec)
+            self._by_seq[rec["seq"]] = rec
+            while len(self._ring) > self._keep:
+                old = self._ring.popleft()
+                self._by_seq.pop(old["seq"], None)
+        if compile_evt is not None:
+            # outside the recorder lock: the journal has its own, and a
+            # mid-request compile inside a request carries its trace id
+            publish_event(
+                "device.compile",
+                program=family,
+                shape=compile_evt["key"],
+                tier=compile_evt["tier"],
+                durationMs=compile_evt["durationMs"],
+            )
+        return rec["seq"]
+
+    @staticmethod
+    def _key_str(program_key) -> str:
+        if isinstance(program_key, str):
+            return program_key
+        if isinstance(program_key, (tuple, list)):
+            return ":".join(str(p) for p in program_key)
+        return str(program_key)
+
+    def note_stage(self, seq: int, *, encode_ms: float | None = None,
+                   fetch_ms: float | None = None) -> None:
+        """Attach a stage timing to a recorded launch (the encode
+        happens before dispatch on the submitting thread, the fetch
+        after it on the fetcher thread — neither is known at
+        :meth:`record_launch` time). No-op once the record has rolled
+        off the ring."""
+        with self._lock:
+            rec = self._by_seq.get(seq)
+            if rec is None:
+                return
+            if encode_ms is not None:
+                rec["encodeMs"] = round(float(encode_ms), 3)
+            if fetch_ms is not None:
+                rec["fetchMs"] = round(float(fetch_ms), 3)
+
+    # -- back-compat module-property views ------------------------------------
+
+    @property
+    def kernel_launches(self) -> int:
+        """XLA gather-kernel launches (the old ``kernel.N_LAUNCHES``)."""
+        with self._lock:
+            return self._seams.get("kernel", 0)
+
+    @property
+    def mesh_launches(self) -> int:
+        """Mesh shard_map launches (the old ``mesh.N_LAUNCHES``)."""
+        with self._lock:
+            return self._seams.get("mesh", 0)
+
+    @property
+    def scatter_dispatches(self) -> int:
+        """Scatter tile-kernel dispatches (``scatter_kernel.N_DISPATCHES``)."""
+        with self._lock:
+            return self._seams.get("scatter", 0)
+
+    @property
+    def sliced_launches(self) -> int:
+        with self._lock:
+            return self._sliced
+
+    @property
+    def evaluated_pairs(self) -> int:
+        with self._lock:
+            return self._pairs
+
+    # -- read surfaces --------------------------------------------------------
+
+    def launches_by_family(self) -> dict:
+        with self._lock:
+            return dict(self._families)
+
+    def _pad_waste_by_family_locked(self) -> dict:
+        return {
+            f: round(1.0 - real / padded, 4)
+            for f, (real, padded) in self._pad.items()
+            if padded
+        }
+
+    def pad_waste_by_family(self) -> dict:
+        """{family: lifetime padding-waste ratio} — wasted pad slots
+        over total padded slots, the structural metric for the
+        ROADMAP item 1 owner-sharded-output follow-up."""
+        with self._lock:
+            return self._pad_waste_by_family_locked()
+
+    def _worst_pad_waste_locked(self) -> dict | None:
+        worst = None
+        for (family, tier), (real, padded) in self._pad_tier.items():
+            if not padded:
+                continue
+            waste = 1.0 - real / padded
+            if worst is None or waste > worst[0]:
+                worst = (waste, family, tier)
+        if worst is None:
+            return None
+        return {
+            "family": worst[1],
+            "tier": worst[2],
+            "waste": round(worst[0], 4),
+        }
+
+    def worst_pad_waste(self) -> dict | None:
+        """The worst (family, tier) padding-waste cell, or None before
+        any launch — ``/debug/status`` diagnosis material."""
+        with self._lock:
+            return self._worst_pad_waste_locked()
+
+    def mid_request_compiles(self) -> int:
+        with self._lock:
+            return self._mid_request
+
+    def last_mid_request_compile(self) -> dict | None:
+        with self._lock:
+            return dict(self._last_mid) if self._last_mid else None
+
+    def _compile_snapshot_locked(self) -> dict:
+        entries = [dict(e) for e in self._compiles.values()]
+        return {
+            "enabled": self.compile_tracking,
+            "programs": len(entries),
+            "midRequestCompiles": self._mid_request,
+            "lastMidRequestCompile": (
+                dict(self._last_mid) if self._last_mid else None
+            ),
+            "warmupShapes": sorted(
+                e["key"] for e in entries if e["warmup"]
+            ),
+            "entries": sorted(entries, key=lambda e: e["time"]),
+        }
+
+    def compile_snapshot(self) -> dict:
+        """The compile cache contents vs the warmup shape set."""
+        with self._lock:
+            return self._compile_snapshot_locked()
+
+    def launch_summary(self) -> dict:
+        """The compact rollup (no ring) ``/debug/status`` embeds."""
+        with self._lock:
+            total = sum(self._families.values())
+            by_family = dict(self._families)
+            sliced = self._sliced
+            pairs = self._pairs
+        return {
+            "total": total,
+            "byFamily": by_family,
+            "sliced": sliced,
+            "evaluatedPairs": pairs,
+        }
+
+    def snapshot(self) -> dict:
+        """The full ``/device/status`` launch document: counters, the
+        ring (oldest first), padding waste by family and tier, and the
+        compile cache — assembled under ONE lock hold, so the ring and
+        the counters describe the same instant (a launch landing
+        between two separate acquisitions would break the
+        ring-vs-counter reconciliation the golden test asserts). Never
+        a stack or publish lock."""
+        with self._lock:
+            ring = [dict(r) for r in self._ring]
+            keep = self._keep
+            seq = self._seq
+            families = dict(self._families)
+            sliced = self._sliced
+            pairs = self._pairs
+            by_family = self._pad_waste_by_family_locked()
+            by_tier = {
+                f"{family}:{tier}": round(1.0 - real / padded, 4)
+                for (family, tier), (real, padded)
+                in sorted(self._pad_tier.items())
+                if padded
+            }
+            worst = self._worst_pad_waste_locked()
+            compiles = self._compile_snapshot_locked()
+        return {
+            "total": sum(families.values()),
+            "byFamily": families,
+            "sliced": sliced,
+            "evaluatedPairs": pairs,
+            "ring": {"size": keep, "recorded": seq, "entries": ring},
+            "padWaste": {
+                "byFamily": by_family,
+                "byTier": by_tier,
+                "worst": worst,
+            },
+            "compiles": compiles,
+        }
+
+def _env_flight_recorder() -> DeviceFlightRecorder:
+    from .config import ENV_OFF
+
+    raw = os.environ.get("BEACON_DEVICE_RING_SIZE", "") or "256"
+    try:
+        ring = int(raw)
+    except ValueError:
+        ring = 256
+    tracking = os.environ.get(
+        "BEACON_COMPILE_TRACKING", ""
+    ).lower() not in ENV_OFF
+    return DeviceFlightRecorder(ring, compile_tracking=tracking)
+
+
+#: the process device-plane flight recorder. Process-global like
+#: ``journal`` — the kernel modules live below the app layer and must
+#: not need a registry reference to be observable.
+flight_recorder = _env_flight_recorder()
+
+
+def record_device_launch(family: str, **kw) -> int:
+    """Record one device launch on the process flight recorder (the
+    kernel seams call this; reading the global at call time keeps the
+    recorder swappable in tests)."""
+    return flight_recorder.record_launch(family, **kw)
+
+
+def note_device_stage(seq, **kw) -> None:
+    """Attach encode/fetch ms to a recorded launch; seq=None no-ops."""
+    if seq is not None:
+        flight_recorder.note_stage(seq, **kw)
+
+
+def device_warmup_phase():
+    """``with device_warmup_phase(): engine.warmup()`` — compiles
+    inside the scope are expected, not mid-request regressions."""
+    return flight_recorder.warmup_phase()
+
+
+def register_device_metrics(registry) -> None:
+    """The device-plane series, callback-backed off the process
+    recorder (the usual app fallback registration: call once per
+    registry; producers keep no registry reference)."""
+    registry.counter(
+        "device.launches",
+        "compiled device-program launches by family (scatter / fused "
+        "/ mesh_replicated / mesh_sliced / plane)",
+        label="family",
+        fn=lambda: flight_recorder.launches_by_family(),
+    )
+    registry.counter(
+        "device.evaluated_pairs",
+        "evaluated (device, query-slot) pairs summed over all mesh "
+        "launches — the per-device FLOP proxy",
+        fn=lambda: flight_recorder.evaluated_pairs,
+    )
+    registry.gauge(
+        "device.pad_waste",
+        "lifetime padding-waste ratio by program family (padded spec "
+        "slots never carrying a real query / total padded slots)",
+        label="family",
+        fn=lambda: flight_recorder.pad_waste_by_family(),
+    )
+    registry.counter(
+        "device.mid_request_compiles",
+        "device-program compiles observed OUTSIDE a warmup phase (a "
+        "novel batch shape paid its XLA compile inside a request)",
+        fn=lambda: flight_recorder.mid_request_compiles(),
+    )
+
+
 # -- profiling hooks ----------------------------------------------------------
 
 

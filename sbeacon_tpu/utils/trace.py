@@ -309,3 +309,27 @@ if tracer.is_enabled:
 
 def span(name: str, **meta):
     return tracer.span(name, **meta)
+
+
+def graft_launch_span(active, *, elapsed_ms: float = 0.0, **meta) -> None:
+    """Adopt one device launch as a ``device.launch`` child span of an
+    open span — the in-process twin of the coordinator's worker-span
+    graft (parallel/dispatch.py ``_graft_worker_spans``): the launch
+    already happened inside ``active``'s scope, so it lays out as the
+    trailing ``elapsed_ms`` of it. No-op while tracing is disabled
+    (``active`` is the null span) — the kernel hot path pays one
+    getattr."""
+    sp = getattr(active, "span", None)
+    if sp is None:
+        return
+    now = time.perf_counter()
+    sp.children.append(
+        Span(
+            name="device.launch",
+            t_start=now - elapsed_ms / 1e3,
+            t_end=now,
+            meta=dict(meta),
+            trace_id=sp.trace_id,
+            span_id=new_span_id(),
+        )
+    )

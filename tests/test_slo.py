@@ -329,7 +329,8 @@ def test_debug_status_schema_and_diagnosis(app):
     assert status == 200
     assert set(doc) == {
         "ready", "beaconId", "slo", "breakers", "routing", "queues",
-        "ingest", "stages", "costs", "canary", "events", "diagnosis",
+        "ingest", "stages", "costs", "canary", "device", "events",
+        "diagnosis",
     }
     # canary rollup (ISSUE 12): the prober exists (idle) on every app
     assert doc["canary"]["registeredProbes"] == 0
@@ -349,9 +350,16 @@ def test_debug_status_schema_and_diagnosis(app):
     # tracked route, so at least one request folded
     assert doc["costs"]["requests"] >= 1
     assert "costliestTenant" in doc["costs"]
+    # device-plane rollup (ISSUE 14): launch decomposition + padding
+    # waste + mid-request compile count ride the same document
+    assert set(doc["device"]) == {
+        "launches", "padWaste", "midRequestCompiles",
+    }
+    assert doc["device"]["launches"]["total"] >= 0
     assert set(doc["diagnosis"]) == {
         "breachedSlos", "openBreakers", "slowestStage", "slowestWorker",
         "costliestTenant", "costliestShape", "canaryMismatches",
+        "worstPadWaste", "midRequestCompiles", "lastMidRequestCompile",
     }
     assert set(doc["events"]) == {"lastSeq", "published"}
     # single-host app: no worker routing section content

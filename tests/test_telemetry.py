@@ -232,6 +232,10 @@ GOLDEN_METRICS = [
     "canary.mismatches",
     "canary.failures",
     "canary.slow_probes",
+    "device.launches",
+    "device.evaluated_pairs",
+    "device.pad_waste",
+    "device.mid_request_compiles",
 ]
 
 
@@ -586,6 +590,64 @@ def test_metric_name_lint_catches_violations():
         ]
     )
     assert len(errors) == 3
+
+
+# -- launch-recording lint (ISSUE 14 satellite) --------------------------------
+
+
+@obs
+def test_launch_recording_lint():
+    """No module may mutate a launch-counter global directly (the
+    pre-ISSUE-14 unlocked read-modify-write race), and every kernel
+    seam must keep its recorder call + back-compat __getattr__."""
+    proc = subprocess.run(
+        [
+            sys.executable,
+            str(REPO / "tools" / "check_launch_recording.py"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@obs
+def test_launch_recording_lint_catches_violations():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_launch_recording import lint_module, lint_seam
+    finally:
+        sys.path.pop(0)
+
+    # a reintroduced module-global increment must fail
+    errs = lint_module(
+        "x.py",
+        "N_LAUNCHES = 0\n"
+        "def f():\n"
+        "    global N_LAUNCHES\n"
+        "    N_LAUNCHES += 1\n",
+    )
+    assert len(errs) == 3  # assign + global decl + aug-assign
+    assert all("N_LAUNCHES" in e for e in errs)
+    # a kernel seam that drops the recorder call or the __getattr__
+    # property must fail both seam checks
+    errs = lint_seam("y.py", "def run():\n    return 1\n")
+    assert len(errs) == 2
+    assert any("__getattr__" in e for e in errs)
+    assert any("record_device_launch" in e for e in errs)
+    # the compliant shape passes
+    ok = lint_seam(
+        "z.py",
+        "def __getattr__(name):\n"
+        "    raise AttributeError(name)\n"
+        "def run():\n"
+        "    from ..telemetry import record_device_launch\n"
+        "    record_device_launch('fused', seam='kernel', tier=8,\n"
+        "                         specs_real=1, specs_padded=8)\n",
+    )
+    assert ok == []
 
 
 # -- annotation-key lint (ISSUE 11 satellite) ----------------------------------

@@ -1,0 +1,161 @@
+#!/usr/bin/env python
+"""Static launch-recording lint (ISSUE 14 satellite).
+
+Device-launch accounting used to live in unlocked module globals
+(``mesh.N_LAUNCHES += 1``, ``kernel.N_LAUNCHES += 1``,
+``scatter_kernel.N_DISPATCHES += 1``) — read-modify-write races across
+request threads on real accelerators, and a counter a new kernel could
+silently fork or forget. Every launch now reports through ONE seam,
+``telemetry.DeviceFlightRecorder.record_launch`` (which also feeds the
+launch ring and the compile tracker), and the old names are module
+``__getattr__`` properties reading the recorder.
+
+This lint keeps it that way:
+
+- NO module under ``sbeacon_tpu/`` may assign or augment a
+  launch-counter name (``N_LAUNCHES`` / ``N_SLICED_LAUNCHES`` /
+  ``N_EVALUATED_PAIRS`` / ``N_DISPATCHES``) — at module scope, inside
+  a function, or via a ``global`` declaration. A reintroduced direct
+  increment is exactly the racy bypass this lint exists to stop;
+- every module that dispatches compiled device programs (the three
+  kernel seams) must keep its module ``__getattr__`` back-compat
+  property AND call the recorder seam (``record_device_launch`` /
+  ``record_launch``) at least once — a new kernel family cloned from
+  one of these files cannot silently drop out of the flight recorder.
+
+Run directly (``python tools/check_launch_recording.py``) or via the
+tier-1 test ``tests/test_telemetry.py::test_launch_recording_lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+
+#: the launch-counter names whose direct mutation is forbidden
+COUNTER_NAMES = frozenset({
+    "N_LAUNCHES",
+    "N_SLICED_LAUNCHES",
+    "N_EVALUATED_PAIRS",
+    "N_DISPATCHES",
+})
+
+#: the modules that dispatch compiled device programs: each must keep
+#: its module __getattr__ property seam and report through the recorder
+KERNEL_SEAMS = (
+    "ops/kernel.py",
+    "ops/scatter_kernel.py",
+    "parallel/mesh.py",
+)
+
+#: the recorder entry points a kernel seam must call
+RECORD_CALLS = frozenset({"record_device_launch", "record_launch"})
+
+
+def _target_names(node: ast.AST) -> set[str]:
+    """Every Name a statement assigns to (tuple targets included)."""
+    out: set[str] = set()
+    targets: list = []
+    if isinstance(node, ast.Assign):
+        targets = node.targets
+    elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        targets = [node.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name):
+                out.add(n.id)
+    return out
+
+
+def lint_module(rel: str, src: str) -> list[str]:
+    """Counter-mutation errors for one module's source."""
+    errors: list[str] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [f"{rel}:{e.lineno}: syntax error: {e.msg}"]
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            hit = sorted(_target_names(node) & COUNTER_NAMES)
+            if hit:
+                errors.append(
+                    f"{rel}:{node.lineno}: direct launch-counter "
+                    f"assignment to {hit} — route the increment "
+                    "through telemetry.record_device_launch (the "
+                    "flight-recorder seam owns these counters)"
+                )
+        elif isinstance(node, ast.Global):
+            hit = sorted(set(node.names) & COUNTER_NAMES)
+            if hit:
+                errors.append(
+                    f"{rel}:{node.lineno}: `global {', '.join(hit)}` "
+                    "declaration — launch counters are flight-recorder "
+                    "state, not module globals"
+                )
+    return errors
+
+
+def lint_seam(rel: str, src: str) -> list[str]:
+    """A kernel-seam module must keep its __getattr__ property and
+    call the recorder at least once."""
+    errors: list[str] = []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # already reported by lint_module
+    has_getattr = any(
+        isinstance(n, ast.FunctionDef) and n.name == "__getattr__"
+        for n in tree.body
+    )
+    if not has_getattr:
+        errors.append(
+            f"{rel}: kernel seam lost its module __getattr__ — the "
+            "back-compat counter properties (N_LAUNCHES etc.) must "
+            "keep reading the flight recorder"
+        )
+    calls = {
+        n.func.id if isinstance(n.func, ast.Name) else n.func.attr
+        for n in ast.walk(tree)
+        if isinstance(n, ast.Call)
+        and isinstance(n.func, (ast.Name, ast.Attribute))
+    }
+    if not calls & RECORD_CALLS:
+        errors.append(
+            f"{rel}: kernel seam never calls the flight recorder "
+            "(record_device_launch) — its launches would be invisible "
+            "to /device/status and the compile tracker"
+        )
+    return errors
+
+
+def main() -> int:
+    errors: list[str] = []
+    checked = 0
+    for path in sorted(PKG.rglob("*.py")):
+        rel = str(path.relative_to(PKG.parent))
+        src = path.read_text()
+        errors += lint_module(rel, src)
+        checked += 1
+    for seam in KERNEL_SEAMS:
+        path = PKG / seam
+        if not path.exists():
+            errors.append(f"sbeacon_tpu/{seam}: kernel seam missing")
+            continue
+        errors += lint_seam(f"sbeacon_tpu/{seam}", path.read_text())
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}")
+        return 1
+    print(
+        f"ok: {checked} modules free of direct launch-counter "
+        f"mutation, {len(KERNEL_SEAMS)} kernel seams report through "
+        "the flight recorder"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

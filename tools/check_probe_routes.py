@@ -214,7 +214,12 @@ def runtime_parity() -> list[str]:
                 f"want {label!r} — the latency histogram would mint a "
                 "divergent series for this probe"
             )
-    for junk in ("/ops/whatever", "/debug/whatever", "/fleet/whatever"):
+    for junk in (
+        "/ops/whatever",
+        "/debug/whatever",
+        "/fleet/whatever",
+        "/device/whatever",
+    ):
         got = BeaconApp._route_label(shim, junk)
         if got != "other":
             errors.append(
