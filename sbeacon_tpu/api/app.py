@@ -902,6 +902,11 @@ class BeaconApp:
         delta_stats = getattr(local, "delta_stats", None)
         if delta_stats is not None:
             ingest["deltaTails"] = delta_stats()
+        l0_status = getattr(local, "l0_status", None)
+        if l0_status is not None:
+            # the L0 delta-tail mini-index (ISSUE 15): built/served
+            # state next to the tails it covers
+            ingest["l0"] = l0_status()
         compactor = getattr(self.ingest, "compactor", None)
         if compactor is not None:
             ingest["compactor"] = compactor.metrics()

@@ -190,6 +190,15 @@ class EngineConfig:
     # AND coordinate bracket overlap the new rows, instead of dropping
     # the whole cache. Off restores the wholesale clear-on-publish.
     scoped_invalidation: bool = True
+    # L0 delta-tail mini-index (ISSUE 15, the LSM memtable->L0 tier):
+    # past EITHER threshold — tail depth in shards, or total tail rows
+    # — a key's standing delta tail is stacked into a secondary fused
+    # device index served by ONE batched launch, so deep tails stop
+    # paying a per-shard host scan per query. 0 disables that trigger;
+    # both 0 disables the L0 tier outright (every tail shard host-
+    # scans, the pre-ISSUE-15 behaviour).
+    l0_min_shards: int = 4
+    l0_min_rows: int = 4096
 
 
 @dataclasses.dataclass(frozen=True)
@@ -229,6 +238,20 @@ class IngestConfig:
     stream_deltas: bool = True
     delta_max_shards: int = 8
     compact_interval_s: float = 30.0
+    # size-tiered compaction (ISSUE 15): >0 arms the tiered fold
+    # policy — raw delta tails fold into intermediate L1 artifacts
+    # (persisted, epoch-ranged, adoptable after a crash) and the full
+    # base merge only runs once the accumulated L1 bytes reach this
+    # ratio of the base's bytes, so per-fold write amplification stops
+    # scaling with base size. <=0 keeps the legacy policy: every fold
+    # is a full base merge.
+    compact_base_ratio: float = 0.0
+    # superseded base/L1 artifacts are parked in a per-key .retired/
+    # dir at each base merge and the newest N generations are kept;
+    # older ones are GC'd (ingest.gc_bytes counts the reclaim). GC
+    # only ever touches .retired/ — a serving artifact can never be
+    # deleted.
+    artifact_retain: int = 2
     # defer the end-of-summarisation BASE publish to the compactor
     # cadence as well (continuous-ingest mode): submits then never pay
     # a fingerprint bump / stack rebuild inline — the standing deltas
@@ -590,6 +613,10 @@ class BeaconConfig:
             eng_over["scoped_invalidation"] = (
                 env["BEACON_SCOPED_INVALIDATION"].lower() not in _off
             )
+        if "BEACON_L0_MIN_SHARDS" in env:
+            eng_over["l0_min_shards"] = int(env["BEACON_L0_MIN_SHARDS"])
+        if "BEACON_L0_MIN_ROWS" in env:
+            eng_over["l0_min_rows"] = int(env["BEACON_L0_MIN_ROWS"])
         if "BEACON_FETCH_PIPELINE_DEPTH" in env:
             eng_over["fetch_pipeline_depth"] = int(
                 env["BEACON_FETCH_PIPELINE_DEPTH"]
@@ -628,6 +655,14 @@ class BeaconConfig:
         if "BEACON_COMPACT_INTERVAL_S" in env:
             ingest_over["compact_interval_s"] = float(
                 env["BEACON_COMPACT_INTERVAL_S"]
+            )
+        if "BEACON_COMPACT_BASE_RATIO" in env:
+            ingest_over["compact_base_ratio"] = float(
+                env["BEACON_COMPACT_BASE_RATIO"]
+            )
+        if "BEACON_ARTIFACT_RETAIN" in env:
+            ingest_over["artifact_retain"] = int(
+                env["BEACON_ARTIFACT_RETAIN"]
             )
         if "BEACON_DEFER_BASE_PUBLISH" in env:
             ingest_over["defer_base_publish"] = (

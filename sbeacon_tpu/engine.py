@@ -663,6 +663,18 @@ def register_delta_metrics(registry, supplier) -> None:
         "delta shards currently standing (awaiting compaction)",
         fn=field("shards"),
     )
+    registry.counter(
+        "ingest.l0_builds",
+        "delta-tail L0 mini-index builds (tail stacked past the "
+        "depth/row threshold)",
+        fn=field("l0_builds"),
+    )
+    registry.counter(
+        "ingest.l0_served_queries",
+        "queries whose delta-tail targets rode the L0 mini-index "
+        "launch instead of per-shard host scans",
+        fn=field("l0_served"),
+    )
 
 
 class VariantEngine:
@@ -774,6 +786,29 @@ class VariantEngine:
         # never iterates a dict an ingest is mutating.
         self._deltas: dict[tuple[str, str], dict[int, object]] = {}
         self._delta_seq: dict[tuple[str, str], int] = {}
+        # L0 delta-tail mini-index (ISSUE 15): keys whose tail passed
+        # the depth/row threshold get their shards stacked into ONE
+        # secondary fused device index (ops.kernel.L0DeviceIndex),
+        # published copy-on-write next to the base stacks. A search
+        # then splits targets THREE ways — mesh/fused base stack, L0
+        # stack (one batched launch for all covered tail rows across
+        # keys), host scan for the sub-threshold residue. A base
+        # publish retires the covered coverage in the same critical
+        # section that drops the delta epochs, so rows are never
+        # doubled or missing. State tuple:
+        # (findex, {serve_key: sid}, {serve_key: shard}, rows, built_at)
+        self._l0_state: tuple | None = None
+        # publish generation for L0 builds (same role as _fused_gen):
+        # a build whose inputs predate ANY delta/base publish must not
+        # publish over fresher state
+        self._l0_gen = 0
+        # L0 program shapes already warmed: the shard-tier/row padding
+        # keeps successive builds on one shape, so warmup runs once
+        # per shape — and covers the FULL batch-tier ladder (incl. the
+        # big coalescing tiers), not just the common small ones
+        self._l0_warmed: set = set()
+        self.l0_builds = 0
+        self.l0_searches = 0
         self._base_fingerprint = ""
         self._ds_fingerprints: dict[str, str] = {}
         self._ds_full_fingerprints: dict[str, str] = {}
@@ -930,6 +965,13 @@ class VariantEngine:
                 else:
                     deltas.pop(key, None)
                 self._deltas = deltas
+            # the covered L0 generation dies in the SAME critical
+            # section that drops the folded epochs: the serve list and
+            # the L0 coverage map change together, so a query can
+            # never pair the new base with tail rows the fold already
+            # absorbed (doubled) or find neither (missing)
+            self._l0_gen += 1
+            self._retire_l0_key_locked(key)
             self._rebuild_serving_state_locked()
             self._plane_reserved.pop(
                 getattr(planes, "_hbm_reservation", None), None
@@ -1029,6 +1071,7 @@ class VariantEngine:
             deltas = dict(self._deltas)
             deltas[key] = tail
             self._deltas = deltas
+            self._l0_gen += 1
             self._rebuild_serving_state_locked()
             self.delta_publishes += 1
         self._invalidate_cache(key[0], regions)
@@ -1039,6 +1082,10 @@ class VariantEngine:
             epoch=epoch,
             rows=shard.n_rows,
         )
+        # past the tail threshold the key's shards stack into the L0
+        # mini-index (inline on the publishing thread — ingest-side,
+        # never a request thread; a no-op below the threshold)
+        self._rebuild_l0()
         return epoch
 
     def has_index(self, dataset_id: str, vcf_location: str) -> bool:
@@ -1051,18 +1098,64 @@ class VariantEngine:
         """Delta shards standing for the key (the compaction trigger)."""
         return len(self._deltas.get((dataset_id, vcf_location), ()))
 
-    def delta_snapshot(self):
+    def delta_snapshot(self, key: tuple | None = None):
         """``[(key, base_shard|None, [(epoch, shard), ...]), ...]`` for
         every key with a standing delta tail, under the publish lock —
-        the compactor folds from this."""
+        the compactor folds from this. ``key`` scopes the snapshot to
+        one ``(dataset, vcf)`` (the depth-trigger fold must touch only
+        the key that tripped it, never every standing tail)."""
         with self._mesh_lock:
             out = []
-            for key, tail in sorted(self._deltas.items()):
-                base = self._indexes.get(key)
+            for k, tail in sorted(self._deltas.items()):
+                if key is not None and k != key:
+                    continue
+                base = self._indexes.get(k)
                 out.append(
-                    (key, base[0] if base else None, sorted(tail.items()))
+                    (k, base[0] if base else None, sorted(tail.items()))
                 )
             return out
+
+    def replace_delta_range(self, key, epochs, shard) -> bool:
+        """Atomically swap a contiguous set of standing tail ``epochs``
+        for ONE merged shard — the size-tiered compactor's L1 seam
+        (ISSUE 15). The merged shard takes the highest replaced epoch
+        (so a later base fold retires it exactly like the raws it
+        absorbed) and carries ``meta['l1_epochs'] = [lo, hi]``. The
+        swap happens in one publish critical section — serve list,
+        delta registry, and L0 coverage change together, so queries
+        never see the range's rows doubled or missing. Returns False
+        (nothing mutated) when any epoch is no longer standing — a
+        racing fold or base publish won; the caller's artifact stays
+        on disk for adoption by the next run."""
+        epochs = sorted(int(e) for e in epochs)
+        lo, hi = epochs[0], epochs[-1]
+        shard.meta["dataset_id"] = key[0]
+        shard.meta["vcf_location"] = key[1]
+        shard.meta["delta_epoch"] = hi
+        shard.meta["l1_epochs"] = [lo, hi]
+        regions = shard_regions(shard)
+        with self._mesh_lock:
+            tail = self._deltas.get(key, {})
+            if any(e not in tail for e in epochs):
+                return False
+            new_tail = {
+                e: s for e, s in tail.items() if e not in epochs
+            }
+            new_tail[hi] = shard
+            deltas = dict(self._deltas)
+            deltas[key] = new_tail
+            self._deltas = deltas
+            self._l0_gen += 1
+            self._retire_l0_key_locked(key)
+            self._rebuild_serving_state_locked()
+        # the merged artifact serves the same ROWS the replaced deltas
+        # did, but the serve-list labels changed (one '#d<hi>' entry
+        # replaces the range) — evict the overlapping cached answers
+        # like a delta publish would, so no stale-shaped response list
+        # outlives the swap
+        self._invalidate_cache(key[0], regions)
+        self._rebuild_l0()
+        return True
 
     def delta_stats(self) -> dict:
         """Per-dataset delta-tail depth for ``/debug/status``:
@@ -1093,7 +1186,273 @@ class VariantEngine:
         return {
             "publishes": self.delta_publishes,
             "shards": sum(len(t) for t in deltas.values()),
+            "l0_builds": self.l0_builds,
+            "l0_served": self.l0_searches,
         }
+
+    # -- L0 delta-tail mini-index (ISSUE 15) --------------------------------
+
+    def _l0_covered_keys(self, deltas) -> list:
+        """Keys whose standing tail is past the L0 threshold (depth in
+        shards OR total rows; a 0 disables that trigger, both 0
+        disables the tier)."""
+        eng = self.config.engine
+        min_shards = getattr(eng, "l0_min_shards", 4)
+        min_rows = getattr(eng, "l0_min_rows", 4096)
+        if min_shards <= 0 and min_rows <= 0:
+            return []
+        out = []
+        for key, tail in sorted(deltas.items()):
+            if min_shards > 0 and len(tail) >= min_shards:
+                out.append(key)
+                continue
+            if min_rows > 0 and (
+                sum(s.n_rows for s in tail.values()) >= min_rows
+            ):
+                out.append(key)
+        return out
+
+    def _retire_l0_key_locked(self, key) -> None:
+        """Drop one key's entries from the L0 coverage map (held under
+        ``_mesh_lock``): its epochs were folded into a base, replaced
+        by an L1 artifact, or wholesale-republished. The stacked
+        arrays may keep dead rows until the next build — harmless,
+        nothing routes to them — but coverage and the serve list must
+        change in the same critical section."""
+        state = self._l0_state
+        if state is None:
+            return
+        ds, vcf = key
+        prefix = f"{vcf}#d"
+        findex, sid_of, shard_of, rows, built_at = state
+        kept = {
+            k: sid
+            for k, sid in sid_of.items()
+            if not (k[0] == ds and k[1].startswith(prefix))
+        }
+        if len(kept) == len(sid_of):
+            return
+        if not kept:
+            self._l0_state = None
+        else:
+            self._l0_state = (
+                findex,
+                kept,
+                {k: shard_of[k] for k in kept},
+                rows,
+                built_at,
+            )
+
+    def _rebuild_l0(self) -> None:
+        """Stack every past-threshold tail into a fresh L0 mini-index
+        and publish it copy-on-write (generation-checked, like the
+        fused stack build: a delta/base publish racing the build wins
+        and the next trigger rebuilds). Runs on the PUBLISHING thread
+        — delta publication is ingest-side, never a request thread —
+        and pre-warms the batch-tier programs inside a warmup phase so
+        the first request launch is a compile-cache hit."""
+        with self._mesh_lock:
+            gen = self._l0_gen
+            deltas = self._deltas
+        keys = self._l0_covered_keys(deltas)
+        if not keys:
+            with self._mesh_lock:
+                if self._l0_gen == gen:
+                    self._l0_state = None
+            return
+        entries = []  # (serve_key, shard) in serve-list order
+        for key in keys:
+            ds, vcf = key
+            for epoch, shard in sorted(deltas[key].items()):
+                entries.append(((ds, f"{vcf}#d{epoch}"), shard))
+        state = self._l0_state
+        if state is not None:
+            sid_of, shard_of = state[1], state[2]
+            if len(sid_of) == len(entries) and all(
+                shard_of.get(k) is s for k, s in entries
+            ):
+                # coverage identical (e.g. a sub-threshold key
+                # published): restacking every covered tail per
+                # unrelated publish would grow quadratically in
+                # publish count for nothing
+                return
+        try:
+            from .ops.kernel import L0DeviceIndex
+
+            findex = L0DeviceIndex([s for _k, s in entries])
+        except Exception:
+            logging.getLogger(__name__).exception(
+                "L0 mini-index build failed; the tail host-scans"
+            )
+            return
+        # warm BEFORE publishing: a request arriving between publish
+        # and warm would dispatch a novel (program, shape) uncompiled
+        # — a mid-request XLA compile on the serving path, the exact
+        # regression this tier exists to avoid. Warming an unpublished
+        # index is safe (same process-wide compile cache), and a
+        # race-discarded build merely pre-warmed shapes the next
+        # build reuses.
+        self._l0_warm(findex)
+        state = (
+            findex,
+            {k: i for i, (k, _s) in enumerate(entries)},
+            dict(entries),
+            int(findex.n_rows),
+            time.time(),
+        )
+        with self._mesh_lock:
+            if self._l0_gen != gen:
+                return  # a publish raced the build; rebuilt on the
+                # next trigger against the fresher tail
+            self._l0_state = state
+            self.l0_builds += 1
+        publish_event(
+            "ingest.l0_build",
+            keys=len(keys),
+            shards=len(entries),
+            rows=int(findex.n_rows),
+        )
+
+    def _l0_warm(self, findex) -> None:
+        """Compile the L0 program at EVERY batch tier of the index's
+        ladder — including the big tiers cross-request coalescing can
+        reach — off the request path, ONCE per program shape (the
+        shard-tier/row padding keeps successive tail builds on one
+        shape, so repeat builds skip this outright instead of paying
+        per-build probe launches). Inside a warmup phase: the compile
+        tracker stamps these shapes expected instead of
+        mid-request."""
+        eng = self.config.engine
+        win = min(
+            eng.window_cap,
+            getattr(findex, "window_hint", eng.window_cap),
+        )
+        shape = (
+            findex.n_padded,
+            getattr(findex, "n_shards_padded", findex.n_shards),
+            win,
+            eng.record_cap,
+        )
+        if shape in self._l0_warmed:
+            return
+        try:
+            with device_warmup_phase():
+                for t in getattr(findex, "batch_tiers", (8, 64)):
+                    run_queries_auto(
+                        findex,
+                        encode_queries(
+                            [QuerySpec("1", 1, 1, 1, 2)] * t,
+                            shard_ids=[0] * t,
+                        ),
+                        window_cap=win,
+                        record_cap=eng.record_cap,
+                    )
+            self._l0_warmed.add(shape)
+        except Exception:
+            logging.getLogger(__name__).exception("L0 warmup failed")
+
+    def l0_status(self) -> dict:
+        """The L0 tier's state, lock-free (GIL-atomic reference read)
+        — the ``/debug/status`` ingest section and the bench read it."""
+        state = self._l0_state
+        doc: dict = {
+            "built": state is not None,
+            "builds": self.l0_builds,
+            "servedQueries": self.l0_searches,
+        }
+        if state is not None:
+            doc["shards"] = len(state[1])
+            doc["rows"] = state[3]
+            doc["ageS"] = round(time.time() - state[4], 1)
+        return doc
+
+    def l0_pre_rows(self, tail_targets, spec_base, payload) -> dict:
+        """``{serve_key: shard-local row ids | None}`` for the
+        delta-tail targets the standing L0 mini-index covers — ONE
+        batched device launch answers ALL covered tail rows across
+        keys, riding the micro-batcher's accumulators so concurrent
+        requests coalesce into the same launch (and the launch's
+        device time pro-rates onto each request's cost vector via the
+        usual fetch-stage accounting). A ``None`` value marks
+        window/record overflow: the caller host-scans that shard
+        uncapped, the per-shard kernel contract.
+
+        THE cost-attribution owner for the tail (ISSUE 15 satellite):
+        exactly the targets about to be HOST-walked — absent from the
+        returned dict (sub-threshold residue, racing republishes via
+        the shard-identity check, host-only wildcard-ref semantics) or
+        marked ``None`` (overflow) — charge ``delta_shards`` here, on
+        the calling request's ambient context. Both dispatch tiers
+        (``_search`` and ``MeshDispatchTier.search``) consult this one
+        seam, so the charging rule cannot diverge between them.
+
+        ``tail_targets`` is ``[((dataset, vcf_label), shard), ...]``
+        with the serve-list ``vcf#d<epoch>`` labels."""
+        out = self._l0_pre_rows(tail_targets, spec_base, payload)
+        n_host = sum(
+            1 for key, _s in tail_targets if out.get(key) is None
+        )
+        if n_host:
+            charge_cost(delta_shards=n_host)
+        return out
+
+    def _l0_pre_rows(self, tail_targets, spec_base, payload) -> dict:
+        state = self._l0_state
+        if state is None or not tail_targets:
+            return {}
+        if payload.selected_samples_only and not self._device_ref_ok(
+            payload, spec_base
+        ):
+            return {}  # N-wildcard ref: host regex semantics only
+        findex, sid_of, shard_of = state[0], state[1], state[2]
+        routes = []
+        for key, shard in tail_targets:
+            sid = sid_of.get(key)
+            if sid is not None and shard_of[key] is shard:
+                routes.append((key, sid))
+        if not routes:
+            return {}
+        eng = self.config.engine
+        specs = [spec_base] * len(routes)
+        sids = [sid for _k, sid in routes]
+        # tail-sized candidate window (the index's own hint): a tail
+        # shard's hit range can never exceed its row count, so the
+        # tighter window is exact — it only shrinks the per-lane
+        # gather. The engine-wide cap still bounds it, and a window
+        # overflow keeps the host-fallback contract either way.
+        win = min(
+            eng.window_cap,
+            getattr(findex, "window_hint", eng.window_cap),
+        )
+        if self._batcher is not None:
+            res = self._batcher.submit_many(
+                findex,
+                specs,
+                shard_ids=sids,
+                window_cap=win,
+                record_cap=eng.record_cap,
+            )
+        else:
+            from .harness.faults import fault_point
+
+            fault_point("kernel.launch")
+            res = run_queries_auto(
+                findex,
+                encode_queries(specs, shard_ids=sids),
+                window_cap=win,
+                record_cap=eng.record_cap,
+            )
+        out = {}
+        for i, (key, sid) in enumerate(routes):
+            if res.overflow[i] or res.n_matched[i] > eng.record_cap:
+                out[key] = None
+            else:
+                rows = res.rows[i][res.rows[i] >= 0]
+                out[key] = findex.to_local_rows(rows, sid)
+        with self._mat_lock:  # unlocked += drops concurrent counts
+            self.l0_searches += 1
+        annotate(dispatch_l0=len(routes))
+        return out
 
     _AUTO_PLANES = object()  # sentinel: build planes unless caller chose
 
@@ -1950,13 +2309,6 @@ class VariantEngine:
             targets.append((ds, vcf, shard, dindex, planes, native))
         if not targets:
             return []
-        # cost attribution: delta-tail shards walked by this query
-        # (their serve-list labels carry the '#d<epoch>' suffix) — the
-        # per-shard host-dispatch tax continuous ingest imposes, now
-        # attributable to the tenant that pays it
-        n_delta = sum(1 for t in targets if "#d" in t[1])
-        if n_delta:
-            charge_cost(delta_shards=n_delta)
         # the submitting request's context: _one_target runs on the
         # scatter pool, whose threads do not inherit thread-locals —
         # re-installing it makes every charge (host rows, batcher
@@ -2000,6 +2352,22 @@ class VariantEngine:
             if not targets:
                 return list(mesh_responses.values())
 
+        # the L0 leg of the three-way split: delta-tail targets the
+        # mini-index covers ride ONE batched launch; everything it
+        # does not cover (sub-threshold residue, racing republishes,
+        # overflow marked None) is the host-scan residue. l0_pre_rows
+        # owns the delta_shards charging rule: only host-walked tail
+        # shards charge (L0-served targets pay device share through
+        # the batcher's fetch-stage pro-rating instead)
+        tail_targets = [
+            ((t[0], t[1]), t[2]) for t in targets if "#d" in t[1]
+        ]
+        l0_rows = (
+            self.l0_pre_rows(tail_targets, spec_base, payload)
+            if tail_targets
+            else {}
+        )
+
         # cross-shard fused dispatch: ONE stacked-index launch answers
         # this query for every covered target (instead of one launch
         # per dataset); uncovered targets — including those the fused
@@ -2035,6 +2403,20 @@ class VariantEngine:
                 )
                 if got is not None:
                     rows, fused = got
+            if rows is None and (ds, vcf) in l0_rows:
+                # the L0 mini-index launch already matched this tail
+                # target; None marks window/record overflow -> the
+                # uncapped host matcher (already charged above)
+                r = l0_rows[(ds, vcf)]
+                rows = (
+                    r
+                    if r is not None
+                    else host_match_rows(
+                        shard,
+                        spec_base,
+                        ref_wildcard=payload.selected_samples_only,
+                    )
+                )
             if rows is None and pre_rows is not None and (ds, vcf) in pre_rows:
                 # the fused stacked launch already matched this target;
                 # None marks window/record overflow -> uncapped host
@@ -2095,11 +2477,32 @@ class VariantEngine:
 
         if len(targets) == 1:
             responses = [_one_target(targets[0])]
-        else:
+        elif not l0_rows:
             # per-dataset scatter (the reference's ThreadPoolExecutor(500)
             # per-dataset dispatch, search_variants.py:77-118): overlaps
             # the per-shard device round-trips instead of serialising them
             responses = list(self._scatter.map(_one_target, targets))
+        else:
+            # L0-covered tail targets have NO device work left — their
+            # rows are already in hand, materialisation is pure host —
+            # so they run inline on the request thread while the
+            # scatter pool overlaps the targets that still pay a
+            # device round-trip (a pool task per tiny tail shard is
+            # mostly scheduling jitter on few-core hosts)
+            pooled = [t for t in targets if (t[0], t[1]) not in l0_rows]
+            pooled_iter = (
+                self._scatter.map(_one_target, pooled)
+                if len(pooled) > 1
+                else map(_one_target, pooled)
+            )
+            got = {
+                (t[0], t[1]): _one_target(t)
+                for t in targets
+                if (t[0], t[1]) in l0_rows
+            }
+            for t, r in zip(pooled, pooled_iter):
+                got[(t[0], t[1])] = r
+            responses = [got[(t[0], t[1])] for t in targets]
         if mesh_responses is not None:
             # reassemble mesh-served base responses + scatter-served
             # tail in the original sorted target order

@@ -105,6 +105,21 @@ class JobLedger:
             );
             """
         )
+        # size-tiered compaction columns (ISSUE 15): additive ALTERs so
+        # a ledger file from an earlier build keeps working (NULL tier
+        # reads as the legacy full-base fold)
+        for col, typ in (
+            ("tier", "TEXT"),
+            ("in_bytes", "INTEGER"),
+            ("out_bytes", "INTEGER"),
+            ("write_amp", "REAL"),
+        ):
+            try:
+                self.conn.execute(
+                    f"ALTER TABLE compactions ADD COLUMN {col} {typ}"
+                )
+            except sqlite3.OperationalError:
+                pass  # column already present
         self.conn.commit()
 
     # -- VCF summarisation state (reference VcfSummaries table) -------------
@@ -254,17 +269,39 @@ class JobLedger:
         folded_through: int,
         folded_shards: int,
         folded_rows: int,
+        tier: str = "base",
+        in_bytes: int = 0,
+        out_bytes: int = 0,
+        write_amp: float | None = None,
     ) -> None:
         """One completed fold: stamps the folded deltas and appends a
-        compaction row (the audit trail /debug and the bench read)."""
+        compaction row (the audit trail /debug and the bench read).
+        ``tier`` names the fold level (``l1`` = raw tail -> epoch-
+        ranged intermediate artifact, ``base`` = full base merge);
+        ``in_bytes``/``out_bytes``/``write_amp`` record the fold's IO
+        and its write amplification (bytes written per delta byte
+        folded — the number size-tiering exists to bound). An L1 fold
+        only stamps ``folded_at`` at the base tier: an L1-absorbed
+        delta still stands (as part of its artifact) until a base
+        merge actually retires the range."""
         with self._txn():
+            if tier == "base":
+                self.conn.execute(
+                    "UPDATE delta_log SET folded_at = ? "
+                    "WHERE dataset_id = ? AND vcf_location = ? "
+                    "AND epoch <= ? AND folded_at IS NULL",
+                    (
+                        time.time(),
+                        dataset_id,
+                        vcf_location,
+                        folded_through,
+                    ),
+                )
             self.conn.execute(
-                "UPDATE delta_log SET folded_at = ? WHERE dataset_id = ? "
-                "AND vcf_location = ? AND epoch <= ? AND folded_at IS NULL",
-                (time.time(), dataset_id, vcf_location, folded_through),
-            )
-            self.conn.execute(
-                "INSERT INTO compactions VALUES (?, ?, ?, ?, ?, ?)",
+                "INSERT INTO compactions (dataset_id, vcf_location, "
+                "folded_through, folded_shards, folded_rows, "
+                "completed_at, tier, in_bytes, out_bytes, write_amp) "
+                "VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)",
                 (
                     dataset_id,
                     vcf_location,
@@ -272,6 +309,10 @@ class JobLedger:
                     folded_shards,
                     folded_rows,
                     time.time(),
+                    tier,
+                    int(in_bytes),
+                    int(out_bytes),
+                    write_amp,
                 ),
             )
 
@@ -282,16 +323,77 @@ class JobLedger:
             "SELECT COALESCE(SUM(CASE WHEN folded_at IS NULL THEN 1 "
             "ELSE 0 END), 0), COUNT(*) FROM delta_log"
         ).fetchone()
+        # folded_rows aggregates the BASE tier only (its pre-tiering
+        # meaning: delta rows retired into base shards) — an L1 fold
+        # and the base merge that later absorbs it would otherwise
+        # count the same rows twice, and every L1 re-consolidation
+        # would re-count its constituents
         runs, rows = self.conn.execute(
-            "SELECT COUNT(*), COALESCE(SUM(folded_rows), 0) "
-            "FROM compactions"
+            "SELECT COUNT(*), COALESCE(SUM(CASE WHEN "
+            "COALESCE(tier, 'base') = 'base' THEN folded_rows "
+            "ELSE 0 END), 0) FROM compactions"
         ).fetchone()
+        tiers = {
+            str(t or "base"): int(n)
+            for t, n in self.conn.execute(
+                "SELECT COALESCE(tier, 'base'), COUNT(*) "
+                "FROM compactions GROUP BY COALESCE(tier, 'base')"
+            ).fetchall()
+        }
+        # aggregate write-amp under the SAME definition as the
+        # per-fold column (out bytes per delta-TAIL byte folded): the
+        # tail denominator is recovered from each row's out/write_amp
+        # — summing in_bytes instead would fold the base's bytes into
+        # the denominator and read ~1.0 even when every fold is a full
+        # base merge, the exact signal this column exists to surface
+        out_sum = 0.0
+        tail_sum = 0.0
+        for ob, ib, wa in self.conn.execute(
+            "SELECT out_bytes, in_bytes, write_amp FROM compactions"
+        ).fetchall():
+            ob = int(ob or 0)
+            out_sum += ob
+            tail_sum += ob / wa if wa else int(ib or 0)
         return {
             "standing_deltas": int(standing or 0),
             "delta_publishes": int(published or 0),
             "compaction_runs": int(runs or 0),
             "compaction_folded_rows": int(rows or 0),
+            "compaction_tiers": tiers,
+            "compaction_write_amp": (
+                round(out_sum / tail_sum, 3) if tail_sum else 0.0
+            ),
         }
+
+    def compaction_log(self, dataset_id: str | None = None) -> list[dict]:
+        """The per-fold audit rows, oldest first — tier, IO bytes and
+        write amplification per fold (the bench's per-fold record)."""
+        sql = (
+            "SELECT dataset_id, vcf_location, folded_through, "
+            "folded_shards, folded_rows, COALESCE(tier, 'base'), "
+            "in_bytes, out_bytes, write_amp, completed_at "
+            "FROM compactions"
+        )
+        args: tuple = ()
+        if dataset_id is not None:
+            sql += " WHERE dataset_id = ?"
+            args = (dataset_id,)
+        sql += " ORDER BY completed_at"
+        return [
+            {
+                "dataset": r[0],
+                "vcf": r[1],
+                "foldedThrough": r[2],
+                "foldedShards": r[3],
+                "foldedRows": r[4],
+                "tier": r[5],
+                "inBytes": r[6],
+                "outBytes": r[7],
+                "writeAmp": r[8],
+                "completedAt": r[9],
+            }
+            for r in self.conn.execute(sql, args).fetchall()
+        ]
 
     # -- dataset aggregation state (reference Datasets control item) --------
 

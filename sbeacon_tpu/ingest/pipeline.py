@@ -251,6 +251,27 @@ class SummarisationPipeline:
             / f"{self._vcf_key(vcf)}.slices"
         )
 
+    def l1_dir(self, dataset_id: str, vcf: str) -> Path:
+        """Standing intermediate (L1) compaction artifacts for the key
+        — epoch-ranged merges of raw delta tails, persisted so a
+        crashed fold's next run adopts instead of re-merging. A
+        nested dir (depth 3): ``load_all``'s ``*/*.npz`` glob never
+        repins an L1 as a base shard."""
+        return (
+            self.config.storage.index_dir
+            / dataset_id
+            / f"{self._vcf_key(vcf)}.l1"
+        )
+
+    def retired_dir(self, dataset_id: str, vcf: str) -> Path:
+        """Superseded base/L1 artifacts parked at each base merge;
+        retention GC deletes ONLY from here (never a serving path)."""
+        return (
+            self.config.storage.index_dir
+            / dataset_id
+            / f"{self._vcf_key(vcf)}.retired"
+        )
+
     # -- per-VCF stage ------------------------------------------------------
 
     def summarise_vcf(self, dataset_id: str, vcf: str) -> VariantIndexShard:

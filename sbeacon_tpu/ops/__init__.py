@@ -1,6 +1,7 @@
 from .kernel import (
     DeviceIndex,
     FusedDeviceIndex,
+    L0DeviceIndex,
     QueryResults,
     QuerySpec,
     ReadyQueryResults,
@@ -102,6 +103,7 @@ def run_queries_auto(
 __all__ = [
     "DeviceIndex",
     "FusedDeviceIndex",
+    "L0DeviceIndex",
     "QueryResults",
     "QuerySpec",
     "ReadyQueryResults",

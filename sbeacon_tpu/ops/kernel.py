@@ -257,6 +257,10 @@ class FusedDeviceIndex:
 
     PAD_UNIT = 8192
 
+    #: flight-recorder program family (the L0 subclass overrides —
+    #: tools/check_launch_recording.py pins the override literal)
+    flight_family = "fused"
+
     def __init__(
         self, shards: list[VariantIndexShard], pad_unit: int | None = None
     ):
@@ -275,11 +279,74 @@ class FusedDeviceIndex:
         self.n_padded = n_pad
         self.n_iters = bisect_iters(n_pad)
         self.n_shards = len(shards)
+        #: shard count as compiled (the L0 subclass pads the segment
+        #: table, so its program identity uses the padded count)
+        self.n_shards_padded = len(shards)
         self.shard_base = base  # int64[k+1]
 
     def to_local_rows(self, rows: np.ndarray, sid: int) -> np.ndarray:
         """Stacked row ids (already -1-filtered) -> shard-local ids."""
         return rows.astype(np.int64) - int(self.shard_base[sid])
+
+
+class L0DeviceIndex(FusedDeviceIndex):
+    """The delta-tail mini-index — the LSM ``memtable -> L0`` tier
+    (ISSUE 15), stacked over a key's standing delta shards.
+
+    Same layout as :class:`FusedDeviceIndex` (``stack_shard_columns``
+    over the tail shards — small rows, contiguous per-shard spans, a
+    per-shard segment table row selected by the encoded query's
+    ``shard`` id), with one addition: the ``[k, 27]`` segment table is
+    padded up to a fixed shard-count tier (all-zero rows — every
+    segment empty, so a pad shard can never match). The tail grows by
+    one shard per delta publish, and without the pad each rebuild
+    would be a novel ``[k, 27]`` operand shape — a fresh XLA compile
+    per publish, exactly the mid-request-compile tail the batch tiers
+    exist to prevent. With it, successive tail builds inside one tier
+    reuse ONE compiled program, and the engine pre-warms the batch
+    tiers at build time (off the request path).
+
+    Launches against this index report to the flight recorder as the
+    ``fused_l0`` family, so /device/status and ``device.launches``
+    attribute tail serving separately from the base fused stack."""
+
+    flight_family = "fused_l0"
+
+    #: pad-to tiers for the segment table's shard axis
+    SHARD_TIERS = (8, 16, 32, 64, 128, 256, 512)
+
+    def __init__(
+        self, shards: list[VariantIndexShard], pad_unit: int | None = None
+    ):
+        super().__init__(shards, pad_unit=pad_unit)
+        k = self.n_shards
+        k_pad = next((t for t in self.SHARD_TIERS if k <= t), k)
+        if k_pad != k:
+            co = np.asarray(self.arrays["chrom_offsets"])
+            pad = np.zeros((k_pad - k, co.shape[1]), dtype=co.dtype)
+            self.arrays["chrom_offsets"] = jnp.asarray(
+                np.concatenate([co, pad])
+            )
+        self.n_shards_padded = k_pad
+        # a tail shard's candidate window can never exceed its own
+        # row count, so the launch may run with a window sized to the
+        # LARGEST tail shard instead of the engine-wide window_cap —
+        # the per-lane gather (the launch's compute) shrinks ~8-16x
+        # for typical tails. Power-of-two with a floor, so the hint
+        # (a static program dimension) is stable across builds.
+        widest = max((s.n_rows for s in shards), default=1)
+        hint = 256
+        while hint < widest:
+            hint *= 2
+        self.window_hint = hint
+
+    #: finer batch-tier ladder than the global BATCH_TIERS: a deep-tail
+    #: query submits one spec per covered tail shard (typically 9-32),
+    #: and padding those to the global 64 tier quadruples the launch's
+    #: compute. The L0 program is tiny (window_hint-sized gathers over
+    #: <=8192 rows), so the extra compiled tiers cost little and the
+    #: engine pre-warms them at build time.
+    batch_tiers = (8, 16, 32, 64, 512, 2048)
 
 
 @dataclass
@@ -538,7 +605,11 @@ def run_queries(
         encode_queries(queries) if isinstance(queries, list) else queries
     )
     b = int(enc["chrom"].shape[0])
-    tier = next((t for t in BATCH_TIERS if b <= t), None)
+    # an index may carry its own (finer) tier ladder — the L0
+    # mini-index does, so a per-tail-shard spec batch is not padded to
+    # the global 64 tier
+    tiers = getattr(dindex, "batch_tiers", BATCH_TIERS)
+    tier = next((t for t in tiers if b <= t), None)
     if b and tier and tier != b:
         enc = {
             k: np.concatenate(
@@ -560,9 +631,13 @@ def run_queries(
         launch_ms = (time.perf_counter() - t0) * 1e3
         # ONE flight-recorder seam per launch: counters, the launch
         # ring, and compile tracking (a first-seen (program, shape)
-        # key below is an XLA compile — jit traces inside this call)
+        # key below is an XLA compile — jit traces inside this call).
+        # The family comes off the index (fused vs fused_l0): L0
+        # tail launches are attributable separately from base-stack
+        # launches on every recorder surface.
+        family = getattr(dindex, "flight_family", "fused")
         seq = record_device_launch(
-            "fused",
+            family,
             seam="kernel",
             tier=padded,
             specs_real=b,
@@ -574,8 +649,14 @@ def run_queries(
                 dindex.n_padded,
                 # a fused stack rebuild can keep n_padded while its
                 # [k, 27] segment table grows a row — a distinct XLA
-                # program, so the shard count is part of the identity
-                getattr(dindex, "n_shards", 1),
+                # program, so the (padded) shard count is part of the
+                # identity; the L0 index pads it to a tier exactly so
+                # this key stays stable across tail builds
+                getattr(
+                    dindex,
+                    "n_shards_padded",
+                    getattr(dindex, "n_shards", 1),
+                ),
                 dindex.n_iters,
                 padded,
                 window_cap,
@@ -586,7 +667,7 @@ def run_queries(
         graft_launch_span(
             sp,
             elapsed_ms=launch_ms,
-            family="fused",
+            family=family,
             tier=padded,
             specs=b,
         )

@@ -35,6 +35,7 @@ reference's ``--samples`` bcftools leaf, search_variants.py:233-258).
 
 from __future__ import annotations
 
+import time
 from functools import partial
 
 import jax
@@ -42,6 +43,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..index.columnar import FLAG, VariantIndexShard
+from ..telemetry import record_device_launch
 
 # R padding tiers: one compiled program per (tier, flags) combination;
 # larger row sets chunk through the top tier (bounded compile cache)
@@ -236,9 +238,6 @@ def plane_row_stats(
             else np.zeros(pindex.n_words, np.uint32),
         )
     tier = next(t for t in _R_TIERS if R <= t)
-    from . import scatter_kernel as _sk
-
-    _sk.N_DISPATCHES += 1
     # pad slots target row 0: counts are trimmed to [:R], OR lanes carry
     # or_sel=0, so the padded reads are never observed
     rows_p = np.zeros(tier, np.int32)
@@ -250,6 +249,7 @@ def plane_row_stats(
         mask = np.full(pindex.n_words, 0xFFFFFFFF, np.uint32)
     else:
         mask = np.asarray(selected_mask_words, dtype=np.uint32)
+    t0 = time.perf_counter()
     counts, or_words = _plane_stats(
         pindex.gt,
         pindex.gt2 if with_counts else pindex.gt,
@@ -261,6 +261,21 @@ def plane_row_stats(
         R=tier,
         with_counts=with_counts,
         with_or=or_sel is not None,
+    )
+    # flight-recorder seam (the scatter seam feeds the historical
+    # N_DISPATCHES property). The old `_sk.N_DISPATCHES += 1` here was
+    # worse than the racy read-modify-write the lint bans: the read
+    # went through scatter_kernel's PEP 562 recorder property and the
+    # write then planted a REAL module attribute, permanently
+    # shadowing the recorder behind a frozen snapshot for every later
+    # reader in the process.
+    record_device_launch(
+        "plane",
+        seam="scatter",
+        tier=tier,
+        specs_real=R,
+        specs_padded=tier,
+        launch_ms=(time.perf_counter() - t0) * 1e3,
     )
     counts, or_words = jax.device_get((counts, or_words))
     return (

@@ -1724,20 +1724,36 @@ class MeshDispatchTier:
                         fused=fused,
                     )
                 )
-        # only the delta tail pays per-shard dispatch (host matching —
-        # deltas are small and carry no device index); that walk is
-        # cost-attributed to the request like the engine's own tail
-        if delta_targets:
+        # the delta tail: the engine's L0 mini-index is consulted
+        # FIRST — a past-threshold tail rides one batched fused_l0
+        # launch and only the residue it does not cover (or overflow,
+        # marked None) host-scans. l0_pre_rows owns the delta_shards
+        # charging rule (only host-walked shards charge), so this
+        # tier and the engine's own tail leg cannot diverge on it.
+        l0_rows: dict = {}
+        l0_fn = getattr(self.engine, "l0_pre_rows", None)
+        if delta_targets and l0_fn is not None:
+            l0_rows = l0_fn(
+                [(key, shard) for key, shard, _n, _p in delta_targets],
+                spec_base,
+                payload,
+            )
+        elif delta_targets:
+            # engines without an L0 registry: every tail shard below
+            # host-walks and charges
             charge_cost(delta_shards=len(delta_targets))
         for key, shard, native, pl in delta_targets:
+            rows = l0_rows.get(key)
+            if rows is None:
+                rows = host_match_rows(
+                    shard,
+                    spec_base,
+                    ref_wildcard=payload.selected_samples_only,
+                )
             responses.append(
                 materialize_response(
                     shard,
-                    host_match_rows(
-                        shard,
-                        spec_base,
-                        ref_wildcard=payload.selected_samples_only,
-                    ),
+                    rows,
                     payload,
                     chrom_label=native,
                     dataset_id=key[0],

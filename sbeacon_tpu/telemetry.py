@@ -717,6 +717,7 @@ ANNOTATION_KEYS = frozenset({
     "batch_ms",
     "breaker",
     "dispatch",
+    "dispatch_l0",
     "dispatch_tier",
     "failover",
     "granularity",
@@ -984,12 +985,15 @@ def publish_event(kind: str, **data) -> int | None:
 
 #: the compiled-program families the device plane dispatches: the
 #: scatter tile kernels, the XLA gather kernel (single-shard and fused
-#: stacked alike — one program family), the mesh shard_map program in
-#: its replicated and sliced batch layouts, and the genotype-plane
-#: program. Every launch record names exactly one of these.
+#: stacked alike — one program family), the delta-tail L0 mini-index
+#: (same kernel, its own family so tail serving is attributable), the
+#: mesh shard_map program in its replicated and sliced batch layouts,
+#: and the genotype-plane program. Every launch record names exactly
+#: one of these.
 DEVICE_FAMILIES = (
     "scatter",
     "fused",
+    "fused_l0",
     "mesh_replicated",
     "mesh_sliced",
     "plane",
@@ -1393,7 +1397,7 @@ def register_device_metrics(registry) -> None:
     registry.counter(
         "device.launches",
         "compiled device-program launches by family (scatter / fused "
-        "/ mesh_replicated / mesh_sliced / plane)",
+        "/ fused_l0 / mesh_replicated / mesh_sliced / plane)",
         label="family",
         fn=lambda: flight_recorder.launches_by_family(),
     )

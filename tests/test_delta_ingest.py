@@ -95,8 +95,11 @@ def _variants(responses) -> set:
     return {v for r in responses for v in r.variants}
 
 
-def _compactor(engine, tmp_path) -> DeltaCompactor:
-    cfg = BeaconConfig(storage=StorageConfig(root=tmp_path / "data"))
+def _compactor(engine, tmp_path, **ingest_over) -> DeltaCompactor:
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "data"),
+        ingest=IngestConfig(**ingest_over),
+    )
     cfg.storage.ensure()
     pipe = SummarisationPipeline(cfg, ledger=JobLedger(), engine=engine)
     return DeltaCompactor(engine, pipe, pipe.ledger, cfg)
@@ -626,5 +629,451 @@ def test_slice_files_deleted_as_folded_and_gauge_returns_to_zero(
         final = pipe.shard_path("dsA", str(vcf))
         assert final.exists()
         assert not pipe._slice_dir("dsA", str(vcf)).exists()
+    finally:
+        eng.close()
+
+
+# -- L0 delta-tail mini-index (ISSUE 15) --------------------------------------
+
+
+def _deep_tail_engine(rng_seed=60, n=500, cut=300, n_deltas=5,
+                      **eng_over):
+    """Base + an ``n_deltas``-deep raw delta tail on one key, with the
+    record set returned so parity twins can be built from it."""
+    recs = random_records(random.Random(rng_seed), chrom="1", n=n,
+                          n_samples=2)
+    eng = _engine(_shard(recs[:cut]), **eng_over)
+    step = (n - cut) // n_deltas
+    for i in range(n_deltas):
+        hi = cut + (i + 1) * step if i < n_deltas - 1 else n
+        eng.add_delta(_shard(recs[cut + i * step:hi], vcf="a.vcf"))
+    return eng, recs
+
+
+def _cost_search(eng, payload):
+    """(responses, CostVector) for one search under a fresh request
+    context — the delta_shards attribution the satellite fix asserts."""
+    from sbeacon_tpu.telemetry import RequestContext, request_context
+
+    ctx = RequestContext(route="test")
+    with request_context(ctx):
+        responses = eng.search(payload)
+    return responses, ctx.cost
+
+
+def test_l0_stack_builds_past_threshold_and_serves_tail():
+    """Past the tail-depth threshold the delta registry stacks the
+    tail into the L0 mini-index; a deep-tail query then pays ZERO
+    per-tail-shard host scans (the structural acceptance claim) and
+    the launch lands in the fused_l0 recorder family."""
+    from sbeacon_tpu.telemetry import flight_recorder
+
+    eng, recs = _deep_tail_engine(l0_min_shards=3, response_cache=False)
+    try:
+        status = eng.l0_status()
+        assert status["built"] and status["shards"] == 5
+        fam0 = flight_recorder.launches_by_family().get("fused_l0", 0)
+        got, cost = _cost_search(eng, _bracket(chrom="1"))
+        assert cost.delta_shards == 0, (
+            "L0-served tail targets must not charge host-scan units"
+        )
+        assert eng.l0_searches >= 1
+        assert flight_recorder.launches_by_family()["fused_l0"] > fam0
+        # answers match a monolith holding every row
+        mono = _engine(_shard(recs))
+        try:
+            assert _variants(got) == _variants(
+                mono.search(_bracket(chrom="1"))
+            )
+        finally:
+            mono.close()
+    finally:
+        eng.close()
+
+
+def test_l0_parity_byte_identical_across_shapes():
+    """base+L0 vs base+host-scanned-tail (the same data, L0 on/off)
+    must be byte-identical per response (dataclasses.asdict) across
+    boolean/count/record x selected-samples shapes — and aggregate-
+    equal to a monolith holding every row."""
+    import dataclasses
+
+    on, recs = _deep_tail_engine(rng_seed=61, l0_min_shards=3,
+                                 response_cache=False)
+    off, _ = _deep_tail_engine(rng_seed=61, l0_min_shards=0,
+                               l0_min_rows=0, response_cache=False)
+    mono = _engine(_shard(recs))
+    try:
+        assert on.l0_status()["built"] and not off.l0_status()["built"]
+        payloads = []
+        for gran in ("boolean", "count", "record"):
+            for alt in (None, "N", "T"):
+                payloads.append(_bracket(chrom="1", gran=gran, alt=alt))
+        sel = _bracket(chrom="1", gran="record")
+        sel.selected_samples_only = True
+        sel.sample_names = {"dsA": ["S0"]}
+        sel.include_samples = True
+        payloads.append(sel)
+        for q in payloads:
+            a = [dataclasses.asdict(r) for r in on.search(q)]
+            b = [dataclasses.asdict(r) for r in off.search(q)]
+            assert a == b, (q.requested_granularity, q.alternate_bases)
+            if q.requested_granularity == "boolean":
+                continue
+            rm = mono.search(q)
+            assert _variants(on.search(q)) == _variants(rm)
+            assert sum(r.call_count for r in on.search(q)) == sum(
+                r.call_count for r in rm
+            )
+    finally:
+        on.close()
+        off.close()
+        mono.close()
+
+
+def test_l0_generation_retired_by_fold_and_residue_still_charged(
+    tmp_path,
+):
+    """A base publish retires the covered L0 generation in the SAME
+    critical section that drops the delta epochs (rows never doubled
+    or missing), and a later sub-threshold residue charges exactly
+    its host-walked shard count."""
+    eng, recs = _deep_tail_engine(l0_min_shards=3, response_cache=False)
+    try:
+        assert eng.l0_status()["built"]
+        pre = _variants(eng.search(_bracket(chrom="1")))
+        comp = _compactor(eng, tmp_path)
+        folded = comp.run_once()
+        assert ("dsA", "a.vcf") in folded
+        # the fold dropped the epochs AND the L0 coverage atomically:
+        # no tail, no L0, answers identical (nothing doubled/missing)
+        assert eng.delta_stats() == {}
+        assert not eng.l0_status()["built"]
+        assert _variants(eng.search(_bracket(chrom="1"))) == pre
+        # a fresh sub-threshold delta is the host-scan residue: its
+        # walk charges exactly one delta_shards unit
+        eng.add_delta(_shard([_rec("1", 900_000)], vcf="a.vcf"))
+        got, cost = _cost_search(eng, _bracket(chrom="1"))
+        assert cost.delta_shards == 1
+        assert any("900000" in v for v in _variants(got))
+    finally:
+        eng.close()
+
+
+def test_delta_shard_charges_match_shards_actually_host_walked():
+    """Satellite regression (cost attribution): with one key's tail
+    L0-served and another key's tail below threshold, delta_shards
+    charges count ONLY the host-walked residue; with L0 disabled the
+    same state charges every tail shard."""
+    def build(l0_shards):
+        recs = random_records(random.Random(62), chrom="1", n=400,
+                              n_samples=2)
+        eng = _engine(
+            _shard(recs[:200]),
+            _shard(random_records(random.Random(63), chrom="1", n=100,
+                                  n_samples=2), ds="dsB", vcf="b.vcf"),
+            l0_min_shards=l0_shards,
+            l0_min_rows=0 if l0_shards == 0 else 4096,
+            response_cache=False,
+        )
+        step = 50
+        for i in range(4):  # dsA: 4-deep tail (past threshold at 3)
+            eng.add_delta(
+                _shard(recs[200 + i * step:250 + i * step], vcf="a.vcf")
+            )
+        # dsB: 2-deep tail (below threshold — the residue)
+        eng.add_delta(_shard([_rec("1", 700_001)], ds="dsB",
+                             vcf="b.vcf"))
+        eng.add_delta(_shard([_rec("1", 700_002)], ds="dsB",
+                             vcf="b.vcf"))
+        return eng
+
+    on = build(3)
+    off = build(0)
+    try:
+        q = _bracket(chrom="1")
+        _got, cost = _cost_search(on, q)
+        assert cost.delta_shards == 2, (
+            "only dsB's host-walked residue may charge"
+        )
+        _got, cost = _cost_search(off, q)
+        assert cost.delta_shards == 6, (
+            "with L0 off every tail shard host-walks and charges"
+        )
+    finally:
+        on.close()
+        off.close()
+
+
+@pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="mesh tier needs >=2 devices (forced-host CI mesh)",
+)
+def test_mesh_tier_delta_tail_rides_l0():
+    """The pod dispatch tier's delta-tail leg consults the L0 stack
+    before falling to host_match_rows: a deep tail next to the mesh
+    launch is L0-served (zero delta_shards charges) and the answers
+    include the tail rows."""
+    from sbeacon_tpu.parallel.dispatch import MeshDispatchTier
+    from sbeacon_tpu.telemetry import RequestContext, request_context
+
+    shards = [
+        _shard(random_records(random.Random(64 + i), chrom="1", n=150,
+                              n_samples=2),
+               ds=f"d{i}", vcf=f"v{i}")
+        for i in range(3)
+    ]
+    eng = _engine(*shards, l0_min_shards=3, response_cache=False)
+    tier = MeshDispatchTier(eng, min_shards=2)
+    try:
+        assert tier.warmup() > 0
+        for i in range(4):
+            eng.add_delta(
+                _shard([_rec("1", 800_000 + i)], ds="d0", vcf="v0")
+            )
+        assert eng.l0_status()["built"]
+        pay = _bracket(chrom="1", datasets=["d0", "d1", "d2"])
+        assert tier.resolve(["d0", "d1", "d2"], pay)
+        served0 = eng.l0_searches
+        ctx = RequestContext(route="test")
+        with request_context(ctx):
+            got = tier.search(pay, {"d0", "d1", "d2"})
+        assert ctx.cost.delta_shards == 0
+        assert eng.l0_searches > served0
+        assert any("800003" in v for v in _variants(got))
+    finally:
+        eng.close()
+
+
+# -- size-tiered compaction + GC (ISSUE 15) -----------------------------------
+
+
+def test_compactor_notify_folds_only_the_tripping_key(tmp_path):
+    """Satellite regression: the depth trigger folds the (dataset,
+    vcf) that tripped it — an unrelated key's deep tail is untouched
+    by another key's trigger (inline path, background thread off)."""
+    recs = random_records(random.Random(65), chrom="1", n=300,
+                          n_samples=2)
+    eng = _engine(
+        _shard(recs[:100]),
+        _shard(recs[100:200], ds="dsB", vcf="b.vcf"),
+    )
+    try:
+        for i in range(3):
+            eng.add_delta(_shard([_rec("1", 10_000 + i)], vcf="a.vcf"))
+            eng.add_delta(_shard([_rec("1", 20_000 + i)], ds="dsB",
+                                 vcf="b.vcf"))
+        comp = _compactor(
+            eng, tmp_path, delta_max_shards=2, compact_interval_s=0.0
+        )
+        comp.notify("dsA", "a.vcf", eng.delta_depth("dsA", "a.vcf"))
+        # dsA folded; dsB's equally deep tail MUST still stand
+        stats = eng.delta_stats()
+        assert "dsA" not in stats
+        assert stats["dsB"]["shards"] == 3, (
+            "another key's trigger folded an unrelated tail"
+        )
+    finally:
+        eng.close()
+
+
+def test_tiered_fold_l1_then_base_on_byte_ratio(tmp_path):
+    """The tier policy: raw tails fold into epoch-ranged L1 artifacts
+    (base fingerprint untouched, write amplification ~1) and the full
+    base merge only runs once accumulated L1 bytes reach the ratio —
+    with per-fold tier/bytes/write-amp recorded in the ledger."""
+    recs = random_records(random.Random(66), chrom="1", n=900,
+                          n_samples=2)
+    eng = _engine(_shard(recs[:500]), l0_min_shards=3)
+    try:
+        for i in range(4):
+            eng.add_delta(
+                _shard(recs[500 + 50 * i:550 + 50 * i], vcf="a.vcf")
+            )
+        q = _bracket(chrom="1")
+        pre = _variants(eng.search(q))
+        base_fp = eng.base_fingerprint()
+        comp = _compactor(
+            eng, tmp_path, compact_base_ratio=0.5, artifact_retain=1
+        )
+        folded = comp.run_once()
+        assert folded[("dsA", "a.vcf")] > 0
+        # L1 only: tail collapsed to one artifact entry, base untouched
+        tail = eng.delta_stats()["dsA"]
+        assert tail["shards"] == 1
+        assert eng.base_fingerprint() == base_fp, (
+            "an L1 fold must not re-merge or republish the base"
+        )
+        assert comp.metrics()["tier_folds"] == {"l1": 1}
+        assert _variants(eng.search(q)) == pre
+        # the artifact is persisted + epoch-ranged
+        assert list(comp.pipeline.l1_dir("dsA", "a.vcf").glob("*.npz"))
+        # accumulate more raws until the byte-ratio trigger fires
+        for i in range(4):
+            eng.add_delta(
+                _shard(recs[700 + 50 * i:750 + 50 * i], vcf="a.vcf")
+            )
+        pre = _variants(eng.search(q))  # now includes the new rows
+        folded = comp.run_once()
+        assert folded[("dsA", "a.vcf")] > 0
+        assert eng.delta_stats() == {}
+        assert eng.base_fingerprint() != base_fp
+        tiers = comp.metrics()["tier_folds"]
+        assert tiers["l1"] == 2 and tiers["base"] == 1
+        log = comp.pipeline.ledger.compaction_log()
+        assert [e["tier"] for e in log] == ["l1", "l1", "base"]
+        assert all(
+            e["inBytes"] > 0 and e["outBytes"] > 0 and e["writeAmp"] > 0
+            for e in log
+        )
+        # L1 write-amp ~1; the base fold's reflects rewriting the base
+        assert log[0]["writeAmp"] < 1.5 < log[-1]["writeAmp"]
+        assert _variants(eng.search(q)) == pre
+    finally:
+        eng.close()
+
+
+@pytest.mark.resilience
+def test_l1_crash_at_merge_seam_keeps_serving_then_refolds(tmp_path):
+    recs = random_records(random.Random(67), chrom="1", n=400,
+                          n_samples=2)
+    eng = _engine(_shard(recs[:300]), l0_min_shards=3)
+    try:
+        for i in range(3):
+            eng.add_delta(
+                _shard(recs[300 + 33 * i:333 + 33 * i], vcf="a.vcf")
+            )
+        q = _bracket(chrom="1")
+        pre = _variants(eng.search(q))
+        pre_calls = sum(r.call_count for r in eng.search(q))
+        comp = _compactor(eng, tmp_path, compact_base_ratio=10.0)
+        faults.install(
+            {
+                "seed": 7,
+                "rules": [
+                    {
+                        "site": "compaction.fold",
+                        "kind": "error",
+                        "rate": 1.0,
+                        "count": 1,
+                        "match": ":l1:merge",
+                    }
+                ],
+            }
+        )
+        try:
+            out = comp.run_once()
+        finally:
+            faults.uninstall()
+        assert out == {}
+        assert comp.metrics()["failures"] == 1
+        # base + L0 + tail keep serving, duplicate-free
+        assert eng.delta_stats()["dsA"]["shards"] == 3
+        assert _variants(eng.search(q)) == pre
+        assert sum(r.call_count for r in eng.search(q)) == pre_calls
+        # next run re-folds
+        folded = comp.run_once()
+        assert folded[("dsA", "a.vcf")] > 0
+        assert eng.delta_stats()["dsA"]["shards"] == 1
+        assert _variants(eng.search(q)) == pre
+    finally:
+        eng.close()
+
+
+@pytest.mark.resilience
+def test_l1_crash_after_persist_adopts_artifact_on_retry(tmp_path):
+    """Crash between the L1 save and the registry swap: the artifact
+    is on disk, nothing served changed; the retry ADOPTS it (same
+    inode — no re-merge) and completes the swap."""
+    recs = random_records(random.Random(68), chrom="1", n=400,
+                          n_samples=2)
+    eng = _engine(_shard(recs[:300]), l0_min_shards=3)
+    try:
+        for i in range(3):
+            eng.add_delta(
+                _shard(recs[300 + 33 * i:333 + 33 * i], vcf="a.vcf")
+            )
+        q = _bracket(chrom="1")
+        pre = _variants(eng.search(q))
+        comp = _compactor(eng, tmp_path, compact_base_ratio=10.0)
+        faults.install(
+            {
+                "seed": 7,
+                "rules": [
+                    {
+                        "site": "compaction.fold",
+                        "kind": "error",
+                        "rate": 1.0,
+                        "count": 1,
+                        "match": ":l1:publish",
+                    }
+                ],
+            }
+        )
+        try:
+            out = comp.run_once()
+        finally:
+            faults.uninstall()
+        assert out == {}
+        arts = list(comp.pipeline.l1_dir("dsA", "a.vcf").glob("*.npz"))
+        assert len(arts) == 1  # persisted, swap never happened
+        stamp = arts[0].stat().st_mtime_ns
+        assert eng.delta_stats()["dsA"]["shards"] == 3
+        assert _variants(eng.search(q)) == pre
+        folded = comp.run_once()
+        assert folded[("dsA", "a.vcf")] > 0
+        assert eng.delta_stats()["dsA"]["shards"] == 1
+        # adopted, not re-merged: the artifact file was not rewritten
+        assert arts[0].stat().st_mtime_ns == stamp
+        assert _variants(eng.search(q)) == pre
+    finally:
+        eng.close()
+
+
+def test_gc_reclaims_superseded_but_never_a_serving_artifact(tmp_path):
+    """Retention GC only ever deletes from .retired/: after repeated
+    base merges with retain=1, superseded generations are reclaimed
+    (gc_bytes > 0) while the serving base artifact and the live
+    answers survive every pass."""
+    from sbeacon_tpu.index.columnar import load_index
+
+    recs = random_records(random.Random(69), chrom="1", n=600,
+                          n_samples=2)
+    eng = _engine(_shard(recs[:300]))
+    try:
+        q = _bracket(chrom="1")
+        comp = _compactor(
+            eng, tmp_path, compact_base_ratio=0.01, artifact_retain=1
+        )
+        for round_ in range(3):
+            lo = 300 + 100 * round_
+            eng.add_delta(_shard(recs[lo:lo + 50], vcf="a.vcf"))
+            eng.add_delta(_shard(recs[lo + 50:lo + 100], vcf="a.vcf"))
+            folded = comp.run_once()  # tiny ratio: l1 then base merge
+            assert folded[("dsA", "a.vcf")] > 0
+            assert eng.delta_stats() == {}
+            final = comp.pipeline.shard_path("dsA", "a.vcf")
+            assert final.exists(), "GC deleted the serving artifact"
+            load_index(final)  # and it is intact
+            got = eng.search(q)
+            assert any(r.exists for r in got)
+        m = comp.metrics()
+        assert m["tier_folds"]["base"] == 3
+        assert m["gc_bytes"] > 0, "retention GC never reclaimed"
+        # retain=1 keeps ONE generation (a merge's base + its L1s as
+        # one rollback unit), not one file
+        retired = comp.pipeline.retired_dir("dsA", "a.vcf")
+        gens = {
+            p.name.split("-", 1)[0] for p in retired.glob("*.npz")
+        }
+        assert len(gens) <= 1
+        # final answers cover every folded round's rows
+        mono = _engine(_shard(recs[:600]))
+        try:
+            assert _variants(eng.search(q)) == _variants(
+                mono.search(q)
+            )
+        finally:
+            mono.close()
     finally:
         eng.close()

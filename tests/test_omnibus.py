@@ -53,6 +53,7 @@ def test_full_fleet_flow(tmp_path):
 
     shared = tmp_path / "shared"
     workers = []
+    services = []
     try:
         with range_server(corpus) as store:
             vcf_url = f"{store}/cohort.vcf.gz"
@@ -99,6 +100,7 @@ def test_full_fleet_flow(tmp_path):
                     )
                 )
                 svc = IngestService(cfg, engine=weng)
+                services.append(svc)
                 workers.append(
                     WorkerServer(
                         weng, token=W_TOKEN, reload_fn=svc.load_all
@@ -119,9 +121,11 @@ def test_full_fleet_flow(tmp_path):
             )
             cfg.storage.ensure()
             app = BeaconApp(cfg)
-            server, _ = start_background(app)
-            base = f"http://127.0.0.1:{server.server_address[1]}"
+            server = None
+            base = ""
             try:
+                server, _ = start_background(app)
+                base = f"http://127.0.0.1:{server.server_address[1]}"
                 # payloadRef submit over HTTP with the bearer token
                 req = urllib.request.Request(
                     f"{base}/submit",
@@ -202,8 +206,17 @@ def test_full_fleet_flow(tmp_path):
                 schema_ref = body["meta"]["returnedSchemas"][0]["schema"]
                 assert schema_ref.endswith("/schemas/genomicVariant")
             finally:
-                server.shutdown()
-                server.server_close()
+                # the app MUST close even on the failure path: its
+                # canary prober / compactor / fleet poller are daemon
+                # threads that otherwise keep probing (and recording
+                # device launches) into every LATER test's window —
+                # the phantom-launch flake in the perf-smoke counters
+                app.close()
+                if server is not None:
+                    server.shutdown()
+                    server.server_close()
     finally:
+        for svc in services:
+            svc.close()
         for w in workers:
             w.shutdown()

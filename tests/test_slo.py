@@ -337,7 +337,7 @@ def test_debug_status_schema_and_diagnosis(app):
     assert doc["canary"]["mismatches"] == 0
     # ingest-while-serving rollup (ISSUE 10): delta-tail depth +
     # compactor counters; empty tails render as {}
-    assert set(doc["ingest"]) <= {"deltaTails", "compactor"}
+    assert set(doc["ingest"]) <= {"deltaTails", "l0", "compactor"}
     assert doc["ready"] is True
     assert set(doc["queues"]) == {
         "admission", "shaping", "runner", "batcher",

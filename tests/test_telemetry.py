@@ -189,9 +189,14 @@ GOLDEN_METRICS = [
     "response_cache.scoped_invalidations",
     "ingest.delta_publishes",
     "ingest.delta_shards",
+    "ingest.l0_builds",
+    "ingest.l0_served_queries",
     "ingest.slice_disk_bytes",
+    "ingest.gc_bytes",
     "compaction.runs",
     "compaction.folded_rows",
+    "compaction.tier_folds",
+    "compaction.write_amplification",
     "transport.conn.opened",
     "transport.conn.reused",
     "transport.conn.evicted",
@@ -617,7 +622,12 @@ def test_launch_recording_lint():
 def test_launch_recording_lint_catches_violations():
     sys.path.insert(0, str(REPO / "tools"))
     try:
-        from check_launch_recording import lint_module, lint_seam
+        from check_launch_recording import (
+            lint_jit_bypass,
+            lint_l0_family,
+            lint_module,
+            lint_seam,
+        )
     finally:
         sys.path.pop(0)
 
@@ -631,6 +641,16 @@ def test_launch_recording_lint_catches_violations():
     )
     assert len(errs) == 3  # assign + global decl + aug-assign
     assert all("N_LAUNCHES" in e for e in errs)
+    # the attribute-target variant must fail too: the read rides the
+    # module's recorder property and the write plants a real attr
+    # that shadows it (the plane_row_stats regression, ISSUE 15)
+    errs = lint_module(
+        "x.py",
+        "from . import scatter_kernel as _sk\n"
+        "def f():\n"
+        "    _sk.N_DISPATCHES += 1\n",
+    )
+    assert len(errs) == 1 and "N_DISPATCHES" in errs[0]
     # a kernel seam that drops the recorder call or the __getattr__
     # property must fail both seam checks
     errs = lint_seam("y.py", "def run():\n    return 1\n")
@@ -648,6 +668,33 @@ def test_launch_recording_lint_catches_violations():
         "                         specs_real=1, specs_padded=8)\n",
     )
     assert ok == []
+    # an L0 dispatch bypassing the recorded run_queries seam (a
+    # direct jitted _query_batch call) must fail anywhere but the
+    # seam module itself (ISSUE 15 satellite)
+    src = "from .ops.kernel import _query_batch\n" \
+          "def serve(arrays, enc):\n" \
+          "    return _query_batch(arrays, enc, window_cap=1,\n" \
+          "                        record_cap=1, n_iters=1)\n"
+    errs = lint_jit_bypass("sbeacon_tpu/engine.py", src)
+    assert len(errs) == 1 and "_query_batch" in errs[0]
+    assert lint_jit_bypass("sbeacon_tpu/ops/kernel.py", src) == []
+    # a dropped / re-pointed L0 family must fail
+    errs = lint_l0_family(
+        "class L0DeviceIndex:\n    flight_family = 'fused'\n",
+        "DEVICE_FAMILIES = ('fused',)\n",
+    )
+    assert len(errs) == 2
+    # quote style must not matter (the check is AST, not substring)...
+    assert lint_l0_family(
+        "class L0DeviceIndex:\n    flight_family = 'fused_l0'\n",
+        "DEVICE_FAMILIES = ('fused', 'fused_l0')\n",
+    ) == []
+    # ...and a stray literal outside the tuple must not satisfy it
+    errs = lint_l0_family(
+        "class L0DeviceIndex:\n    flight_family = 'fused_l0'\n",
+        'X = "fused_l0"\nDEVICE_FAMILIES = ("fused",)\n',
+    )
+    assert len(errs) == 1 and "DEVICE_FAMILIES" in errs[0]
 
 
 # -- annotation-key lint (ISSUE 11 satellite) ----------------------------------

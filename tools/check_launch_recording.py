@@ -21,7 +21,14 @@ This lint keeps it that way:
   kernel seams) must keep its module ``__getattr__`` back-compat
   property AND call the recorder seam (``record_device_launch`` /
   ``record_launch``) at least once — a new kernel family cloned from
-  one of these files cannot silently drop out of the flight recorder.
+  one of these files cannot silently drop out of the flight recorder;
+- the L0 delta-tail mini-index (ISSUE 15) must stay inside the
+  recorded seam: no module other than ``ops/kernel.py`` may call the
+  jitted ``_query_batch`` entry directly (a dispatch bypassing
+  ``run_queries`` would be invisible to the flight recorder), the
+  ``L0DeviceIndex`` class must pin ``flight_family = "fused_l0"``
+  (its launches are attributable separately from the base fused
+  stack), and ``telemetry.DEVICE_FAMILIES`` must carry the family.
 
 Run directly (``python tools/check_launch_recording.py``) or via the
 tier-1 test ``tests/test_telemetry.py::test_launch_recording_lint``.
@@ -54,9 +61,19 @@ KERNEL_SEAMS = (
 #: the recorder entry points a kernel seam must call
 RECORD_CALLS = frozenset({"record_device_launch", "record_launch"})
 
+#: the jitted query-batch entry: only its own module (the recorded
+#: run_queries seam) may invoke it — an L0 (or any) dispatch calling
+#: it directly would launch device programs the recorder never sees
+JIT_ENTRY = "_query_batch"
+JIT_ENTRY_HOME = "ops/kernel.py"
+
 
 def _target_names(node: ast.AST) -> set[str]:
-    """Every Name a statement assigns to (tuple targets included)."""
+    """Every name a statement assigns to — bare Names (tuple targets
+    included) AND attribute targets (``mod.N_DISPATCHES += 1`` is the
+    sneakier variant: the read goes through the module's PEP 562
+    recorder property and the write plants a REAL attribute that
+    shadows it for every later reader in the process)."""
     out: set[str] = set()
     targets: list = []
     if isinstance(node, ast.Assign):
@@ -67,6 +84,8 @@ def _target_names(node: ast.AST) -> set[str]:
         for n in ast.walk(t):
             if isinstance(n, ast.Name):
                 out.add(n.id)
+            elif isinstance(n, ast.Attribute):
+                out.add(n.attr)
     return out
 
 
@@ -95,6 +114,90 @@ def lint_module(rel: str, src: str) -> list[str]:
                     "declaration — launch counters are flight-recorder "
                     "state, not module globals"
                 )
+    return errors
+
+
+def lint_jit_bypass(rel: str, src: str) -> list[str]:
+    """No module outside the kernel seam may call ``_query_batch``
+    directly — the recorded ``run_queries`` entry is the only door."""
+    if rel.replace("\\", "/").endswith(JIT_ENTRY_HOME):
+        return []
+    try:
+        tree = ast.parse(src)
+    except SyntaxError:
+        return []  # already reported by lint_module
+    errors = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        name = (
+            fn.id
+            if isinstance(fn, ast.Name)
+            else fn.attr if isinstance(fn, ast.Attribute) else None
+        )
+        if name == JIT_ENTRY:
+            errors.append(
+                f"{rel}:{node.lineno}: direct {JIT_ENTRY} call — "
+                "dispatch through ops.kernel.run_queries (the "
+                "flight-recorder seam); a bypassed launch is "
+                "invisible to /device/status and the compile tracker"
+            )
+    return errors
+
+
+def lint_l0_family(kernel_src: str, telemetry_src: str) -> list[str]:
+    """The L0 mini-index must keep its own recorder family: the class
+    pins ``flight_family = 'fused_l0'`` (run_queries reads it per
+    launch) and telemetry's DEVICE_FAMILIES literal carries it."""
+    errors: list[str] = []
+    try:
+        tree = ast.parse(kernel_src)
+    except SyntaxError:
+        return []
+    fam = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == "L0DeviceIndex":
+            for stmt in node.body:
+                if (
+                    isinstance(stmt, ast.Assign)
+                    and any(
+                        isinstance(t, ast.Name)
+                        and t.id == "flight_family"
+                        for t in stmt.targets
+                    )
+                    and isinstance(stmt.value, ast.Constant)
+                ):
+                    fam = stmt.value.value
+    if fam != "fused_l0":
+        errors.append(
+            "sbeacon_tpu/ops/kernel.py: L0DeviceIndex must pin "
+            "flight_family = 'fused_l0' — L0 tail launches must stay "
+            "attributable apart from the base fused stack"
+        )
+    # the DEVICE_FAMILIES tuple itself must carry the family — AST,
+    # not a substring scan: quote style must not matter, and a
+    # "fused_l0" literal elsewhere in the module must not satisfy it
+    families: set = set()
+    try:
+        for node in ast.walk(ast.parse(telemetry_src)):
+            if isinstance(node, ast.Assign) and any(
+                isinstance(t, ast.Name) and t.id == "DEVICE_FAMILIES"
+                for t in node.targets
+            ):
+                if isinstance(node.value, (ast.Tuple, ast.List)):
+                    families = {
+                        e.value
+                        for e in node.value.elts
+                        if isinstance(e, ast.Constant)
+                    }
+    except SyntaxError:
+        pass
+    if "fused_l0" not in families:
+        errors.append(
+            "sbeacon_tpu/telemetry.py: DEVICE_FAMILIES lost the "
+            "'fused_l0' family the L0 launch seam reports as"
+        )
     return errors
 
 
@@ -138,6 +241,7 @@ def main() -> int:
         rel = str(path.relative_to(PKG.parent))
         src = path.read_text()
         errors += lint_module(rel, src)
+        errors += lint_jit_bypass(rel, src)
         checked += 1
     for seam in KERNEL_SEAMS:
         path = PKG / seam
@@ -145,6 +249,12 @@ def main() -> int:
             errors.append(f"sbeacon_tpu/{seam}: kernel seam missing")
             continue
         errors += lint_seam(f"sbeacon_tpu/{seam}", path.read_text())
+    kernel = PKG / "ops" / "kernel.py"
+    telemetry = PKG / "telemetry.py"
+    if kernel.exists() and telemetry.exists():
+        errors += lint_l0_family(
+            kernel.read_text(), telemetry.read_text()
+        )
     if errors:
         for e in errors:
             print(f"ERROR: {e}")
