@@ -3426,6 +3426,140 @@ def config19_lsm():
     return out
 
 
+def config20_migrate():
+    """Live shard migration under load (ISSUE 16): config14-style
+    warm-query traffic hammers a two-worker fleet while the dataset
+    (base + delta tail) migrates source -> target through
+    copy / dual-serve / canary-verify / cut-over. Records the serving
+    p99 during the migration vs idle (the dual-serve tax), wall time
+    to cut-over, canary rounds run, bytes copied, and — the hard
+    requirement — zero query errors across the whole window."""
+    import random as _random
+    import threading
+
+    import numpy as _np
+
+    from sbeacon_tpu.config import BeaconConfig, EngineConfig
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.parallel.dispatch import (
+        DistributedEngine,
+        WorkerServer,
+    )
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records
+
+    rng = _random.Random(2000)
+    cfg = BeaconConfig(engine=EngineConfig(use_mesh=False,
+                                           microbatch=False))
+
+    def _shard(seed, n):
+        return build_index(
+            random_records(_random.Random(seed), chrom="21", n=n,
+                           n_samples=2),
+            dataset_id="mg", vcf_location="synthetic://mg",
+            sample_names=["A", "B"],
+        )
+
+    src = VariantEngine(cfg)
+    src.add_index(_shard(31, 6000))
+    src.add_delta(_shard(32, 800))
+    tgt = VariantEngine(cfg)
+    w_src = WorkerServer(src).start_background()
+    w_tgt = WorkerServer(tgt).start_background()
+    dist = DistributedEngine([w_src.address], config=cfg,
+                             timeout_s=30.0)
+    dist.replica_table()
+
+    def _q(k):
+        lo = 1 + 131 * (k % 32)
+        return VariantQueryPayload(
+            dataset_ids=["mg"], reference_name="21", start_min=lo,
+            start_max=lo + (1 << 27), end_min=lo,
+            end_max=lo + (1 << 27) + 64, alternate_bases="N",
+            requested_granularity="count", include_datasets="HIT",
+        )
+
+    warm = [_q(k) for k in range(32)]
+    for q in warm:
+        dist.search(q)
+
+    def _measure(n_rounds):
+        lat = []
+        for _ in range(n_rounds):
+            for q in warm:
+                t0 = time.perf_counter()
+                dist.search(q)
+                lat.append((time.perf_counter() - t0) * 1e3)
+        a = _np.asarray(lat)
+        return {
+            "p50_ms": round(float(_np.percentile(a, 50)), 3),
+            "p99_ms": round(float(_np.percentile(a, 99)), 3),
+        }
+
+    out: dict = {}
+    try:
+        idle = _measure(20)
+
+        lat_during: list = []
+        errors: list = []
+        stop = threading.Event()
+
+        def querier():
+            while not stop.is_set():
+                for q in warm:
+                    t0 = time.perf_counter()
+                    try:
+                        dist.search(q)
+                    except Exception as e:  # any error fails the run
+                        errors.append(repr(e))
+                    lat_during.append(
+                        (time.perf_counter() - t0) * 1e3
+                    )
+                time.sleep(0.001)
+
+        qt = threading.Thread(target=querier, daemon=True)
+        qt.start()
+        t0 = time.perf_counter()
+        m = dist.migrations.run("mg", w_src.address, w_tgt.address)
+        time_to_cutover = time.perf_counter() - t0
+        # keep traffic flowing briefly over the cut-over fleet
+        time.sleep(0.3)
+        stop.set()
+        qt.join(timeout=10)
+
+        a = _np.asarray(lat_during) if lat_during else _np.zeros(1)
+        during = {
+            "p50_ms": round(float(_np.percentile(a, 50)), 3),
+            "p99_ms": round(float(_np.percentile(a, 99)), 3),
+        }
+        out = {
+            "phase": m.phase,
+            "time_to_cutover_s": round(time_to_cutover, 2),
+            "copy_s": round(m.copy_s, 2),
+            "verify_rounds": m.verify_rounds,
+            "bytes_copied": m.bytes_copied,
+            "artifacts_copied": m.artifacts_copied,
+            "idle": idle,
+            "during_migration": during,
+            "p99_ratio_vs_idle": round(
+                during["p99_ms"] / max(idle["p99_ms"], 1e-9), 2
+            ),
+            "queries_during": len(lat_during),
+            "query_errors": len(errors),
+            "routed_after": list(
+                dist.replica_table(refresh=True).get("mg", ())
+            ),
+        }
+        if errors:
+            out["first_errors"] = errors[:3]
+    finally:
+        dist.close()
+        w_src.shutdown()
+        w_tgt.shutdown()
+    return out
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -3564,6 +3698,7 @@ def main() -> None:
     run("config17_mesh_slice", 120, config17_mesh_slice)
     run("config18_device", 40, config18_device)
     run("config19_lsm", 60, config19_lsm)
+    run("config20_migrate", 45, config20_migrate)
     emit(final=True)
 
 

@@ -748,6 +748,18 @@ class BeaconApp:
             # digest, with a fleet-level diagnosis (stalest replica,
             # hottest worker, divergent fingerprints)
             return 200, self._fleet_status()
+        if head == "fleet/migrations":
+            # live shard-migration history + in-flight phases: a
+            # diagnostic read (the POST trigger is /fleet/migrate,
+            # behind the worker-token gate)
+            ctl = getattr(self.engine, "migrations", None)
+            return 200, {
+                "migrations": ctl.status() if ctl is not None else [],
+                "counters": (
+                    ctl.counters() if ctl is not None else {}
+                ),
+                "stuck": ctl.stuck() if ctl is not None else None,
+            }
         if head == "debug/status":
             return 200, self._debug_status()
         if head == "device/status":
@@ -848,6 +860,41 @@ class BeaconApp:
             doc = fleet.snapshot()
         doc["local"] = local
         return doc
+
+    def _fleet_migrate(self, body: dict) -> tuple[int, dict]:
+        """``POST /fleet/migrate``: launch a live shard migration
+        (copy -> dual-serve -> canary-verify -> cut-over) on the
+        fan-out engine's controller. 202: the protocol runs on a
+        background thread — poll ``GET /fleet/migrations`` for phase
+        progress; 409: the request was rejected up front (dataset
+        already migrating, migrations disabled, bad endpoints)."""
+        from ..parallel.migration import MigrationError
+
+        ctl = getattr(self.engine, "migrations", None)
+        if ctl is None:
+            return 400, self.env.error(
+                400,
+                "this deployment has no migration controller "
+                "(single-host engine — nothing to migrate between)",
+            )
+        dataset = str(body.get("dataset") or "")
+        source = str(body.get("source") or "")
+        target = str(body.get("target") or "")
+        if not dataset or not source or not target:
+            return 400, self.env.error(
+                400, "fleet/migrate needs dataset, source and target"
+            )
+        try:
+            m = ctl.start(dataset, source, target)
+        except MigrationError as e:
+            return 409, self.env.error(409, str(e))
+        return 202, {
+            "migrationId": m.id,
+            "dataset": m.dataset,
+            "source": m.source,
+            "target": m.target,
+            "phase": m.phase,
+        }
 
     def _debug_status(self) -> dict:
         """The self-diagnosis rollup: SLO state, breaker states,
@@ -1052,7 +1099,34 @@ class BeaconApp:
         submit resource carries the AWS_IAM authorizer. Standard HTTP
         semantics decide the status structurally: no credential presented
         (no Authorization header) -> 401; credential presented but
-        rejected by the verifier -> 403."""
+        rejected by the verifier -> 403.
+
+        ``POST /fleet/migrate`` is the exception: it rides the
+        WORKER-token trust boundary (``BEACON_WORKER_TOKEN``), not the
+        submit authorizer — triggering a migration drives ``/migrate/*``
+        artifact reads and drops across the fleet, so it carries the
+        same secret and the same blast radius as direct worker access.
+        Empty worker token = open (dev mode / private network), matching
+        the worker endpoints themselves."""
+        if (
+            path.strip("/") == "fleet/migrate"
+            and method == "POST"
+        ):
+            token = self.config.auth.worker_token
+            if not token:
+                return None
+            got = _authorization_header(headers or {})
+            if not got:
+                return 401, self.env.error(
+                    401, "missing Authorization header"
+                )
+            if not hmac.compare_digest(
+                got.encode(), f"Bearer {token}".encode()
+            ):
+                return 403, self.env.error(
+                    403, "fleet/migrate requires the worker token"
+                )
+            return None
         if self.auth_verifier is None:
             return None
         if path.strip("/") != "submit" or method not in ("POST", "PATCH"):
@@ -1133,6 +1207,16 @@ class BeaconApp:
                     self, body or {}, update=(method == "PATCH")
                 )
                 return 200, summary
+
+        if parts == ["fleet", "migrate"]:
+            # the migrate trigger (worker-token gated in _check_auth);
+            # /fleet/status and /fleet/migrations are probe reads and
+            # never reach this router
+            if method != "POST":
+                return 405, self.env.error(
+                    405, "fleet/migrate accepts POST"
+                )
+            return self._fleet_migrate(body or {})
 
         req = parse_request(method, query_params, body)
 

@@ -483,6 +483,17 @@ class ObservabilityConfig:
       a compile outside warmup emits a ``device.compile`` journal
       event and ticks ``device.mid_request_compiles``
       (``BEACON_COMPILE_TRACKING``).
+
+    Live shard migration (parallel/migration.py; ISSUE 16):
+    migration_enabled: serve ``POST /fleet/migrate``
+      (``BEACON_MIGRATION_ENABLED``; ``GET /fleet/migrations`` always
+      answers — observing history is never disabled).
+    migration_verify_rounds: consecutive CLEAN canary-verify rounds
+      the target must answer before cut-over
+      (``BEACON_MIGRATION_VERIFY_ROUNDS``, floor 1).
+    migration_copy_timeout_s: wall budget for the copy phase; also
+      the base of the stuck-migration diagnosis
+      (``BEACON_MIGRATION_COPY_TIMEOUT_S``).
     """
 
     slow_query_ms: float = 1000.0
@@ -503,6 +514,9 @@ class ObservabilityConfig:
     canary_latency_ms: float = 1000.0
     device_ring_size: int = 256
     compile_tracking: bool = True
+    migration_enabled: bool = True
+    migration_verify_rounds: int = 3
+    migration_copy_timeout_s: float = 120.0
 
 
 @dataclasses.dataclass(frozen=True)
@@ -735,6 +749,14 @@ class BeaconConfig:
             "BEACON_CANARY_INTERVAL_S": ("canary_interval_s", float),
             "BEACON_CANARY_LATENCY_MS": ("canary_latency_ms", float),
             "BEACON_DEVICE_RING_SIZE": ("device_ring_size", int),
+            "BEACON_MIGRATION_VERIFY_ROUNDS": (
+                "migration_verify_rounds",
+                int,
+            ),
+            "BEACON_MIGRATION_COPY_TIMEOUT_S": (
+                "migration_copy_timeout_s",
+                float,
+            ),
         }
         for var, (field, conv) in _obs_env.items():
             if var in env:
@@ -750,6 +772,10 @@ class BeaconConfig:
         if "BEACON_COMPILE_TRACKING" in env:
             obs_over["compile_tracking"] = (
                 env["BEACON_COMPILE_TRACKING"].lower() not in _off
+            )
+        if "BEACON_MIGRATION_ENABLED" in env:
+            obs_over["migration_enabled"] = (
+                env["BEACON_MIGRATION_ENABLED"].lower() not in _off
             )
         if "BEACON_COST_ACCOUNTING" in env:
             obs_over["cost_accounting"] = (

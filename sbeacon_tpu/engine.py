@@ -1190,6 +1190,164 @@ class VariantEngine:
             "l0_served": self.l0_searches,
         }
 
+    # -- live shard migration (ISSUE 16) ------------------------------------
+
+    def migration_manifest(self, dataset_id: str) -> dict:
+        """The dataset's artifact inventory for the migration copy
+        phase, read under the publish lock so base and tail are ONE
+        consistent cut. Per-artifact identity rides the SAME
+        epoch-ranged fingerprint components replica grouping reads
+        (the 4-field base comp, the ``vcf#d<epoch>|rows`` tail parts):
+        a crashed copy's re-run diffs manifests by these keys and
+        resumes — already-adopted artifacts are skipped, never
+        re-streamed."""
+        with self._mesh_lock:
+            artifacts: list[dict] = []
+            for (ds, vcf), (s, _d, _p) in sorted(self._indexes.items()):
+                if ds != dataset_id:
+                    continue
+                artifacts.append(
+                    {
+                        "kind": "base",
+                        "vcf": vcf,
+                        "fingerprint": (
+                            f"{vcf}|{s.meta.get('variant_count')}"
+                            f"|{s.meta.get('call_count')}|{s.n_rows}"
+                        ),
+                        "rows": int(s.n_rows),
+                        "deltaEpoch": int(
+                            s.meta.get("delta_epoch") or 0
+                        ),
+                    }
+                )
+            for (ds, vcf), tail in sorted(self._deltas.items()):
+                if ds != dataset_id:
+                    continue
+                for epoch, s in sorted(tail.items()):
+                    art = {
+                        "kind": "delta",
+                        "vcf": vcf,
+                        "epoch": int(epoch),
+                        "fingerprint": f"{vcf}#d{epoch}|{s.n_rows}",
+                        "rows": int(s.n_rows),
+                    }
+                    l1 = s.meta.get("l1_epochs")
+                    if l1:
+                        art["l1Epochs"] = [int(l1[0]), int(l1[-1])]
+                    artifacts.append(art)
+        doc: dict = {"dataset": dataset_id, "artifacts": artifacts}
+        # the canary bracket rides along (outside the lock — it reads
+        # the copy-on-write serve list) so the migration controller's
+        # verify phase probes source and target with the SAME
+        # known-answer grammar the canary prober uses
+        bracket = self.canary_brackets().get(dataset_id)
+        if bracket:
+            doc["bracket"] = bracket
+        return doc
+
+    def export_artifact(
+        self, dataset_id: str, vcf: str, epoch=None
+    ):
+        """One serving artifact for the migration fetch — the base
+        shard when ``epoch`` is None, else the standing delta at that
+        epoch — or None when it no longer stands (a racing fold
+        retired it; the copier re-diffs manifests and moves on).
+        Lock-free: GIL-atomic dict reads over immutable triples."""
+        key = (dataset_id, vcf)
+        if epoch is None:
+            triple = self._indexes.get(key)
+            return None if triple is None else triple[0]
+        return (self._deltas.get(key) or {}).get(int(epoch))
+
+    def adopt_delta(self, shard: VariantIndexShard, epoch: int) -> bool:
+        """Install a MIGRATED delta shard at its ORIGINAL epoch.
+        Unlike :meth:`add_delta` — which assigns the next local epoch —
+        adoption must preserve the source's numbering, or the target's
+        tail fingerprint parts could never equal the source's and
+        dual-serve grouping would hold the copies divergent forever.
+        Idempotent for the crashed-copy resume: returns False (nothing
+        mutated) when the epoch already stands or a base publish
+        already folded past it."""
+        epoch = int(epoch)
+        key = (
+            shard.meta.get("dataset_id", ""),
+            shard.meta.get("vcf_location", ""),
+        )
+        regions = shard_regions(shard)
+        with self._mesh_lock:
+            base = self._indexes.get(key)
+            baked = (
+                base[0].meta.get("delta_epoch") or 0
+            ) if base else 0
+            tail = dict(self._deltas.get(key, {}))
+            if epoch <= baked or epoch in tail:
+                return False
+            shard.meta["delta_epoch"] = epoch
+            tail[epoch] = shard
+            deltas = dict(self._deltas)
+            deltas[key] = tail
+            self._deltas = deltas
+            if epoch > self._delta_seq.get(key, 0):
+                self._delta_seq[key] = epoch
+            self._l0_gen += 1
+            self._rebuild_serving_state_locked()
+            self.delta_publishes += 1
+        self._invalidate_cache(key[0], regions)
+        publish_event(
+            "ingest.delta_adopt",
+            dataset=key[0],
+            vcf=key[1],
+            epoch=epoch,
+            rows=shard.n_rows,
+        )
+        self._rebuild_l0()
+        return True
+
+    def drop_dataset(self, dataset_id: str) -> int:
+        """Retire EVERY shard (base + standing tail) of one dataset in
+        a single publish critical section — the migration cut-over's
+        final step on the source, after the router stopped routing to
+        it and its in-flight legs drained (and the rollback's cleanup
+        on a half-copied target). Copy-on-write like the delta
+        registry, so lock-free diagnostic readers never observe a
+        half-removed dataset. Returns the base shards removed (0 =
+        dataset unknown)."""
+        with self._mesh_lock:
+            base_keys = [
+                k for k in self._indexes if k[0] == dataset_id
+            ]
+            delta_keys = [
+                k for k in self._deltas if k[0] == dataset_id
+            ]
+            if not base_keys and not delta_keys:
+                return 0
+            if base_keys:
+                indexes = dict(self._indexes)
+                for k in base_keys:
+                    indexes.pop(k, None)
+                self._indexes = indexes
+            if delta_keys:
+                deltas = dict(self._deltas)
+                for k in delta_keys:
+                    deltas.pop(k, None)
+                self._deltas = deltas
+            for k in set(base_keys) | set(delta_keys):
+                self._delta_seq.pop(k, None)
+                self._retire_l0_key_locked(k)
+            self._mesh_dirty = True
+            self._fused_dirty = True
+            self._fused_gen += 1
+            self._l0_gen += 1
+            self._rebuild_serving_state_locked()
+        self._invalidate_cache(dataset_id, None)
+        publish_event(
+            "ingest.dataset_drop",
+            dataset=dataset_id,
+            shards=len(base_keys),
+        )
+        self._rebuild_l0()
+        return len(base_keys)
+
     # -- L0 delta-tail mini-index (ISSUE 15) --------------------------------
 
     def _l0_covered_keys(self, deltas) -> list:

@@ -41,6 +41,16 @@ Instrumented sites:
   durability seam. An ``error`` anywhere leaves base + deltas serving
   duplicate-free and the next run completes the fold — the
   ``-m resilience`` test asserts exactly that.
+- ``migration:copy`` / ``migration:dual_serve`` / ``migration:verify``
+  / ``migration:cutover`` — the live shard-migration controller's four
+  phase-entry seams (``parallel/migration.py MigrationController``),
+  hit once at each transition with ``detail``
+  ``"<dataset>:<source>-><target>"``. An ``error`` at any seam must
+  leave the fleet with the source still routed and serving: a copy
+  crash resumes on the next run (manifest diff skips adopted
+  artifacts), the later seams roll the target back — never a
+  half-routed state. The ``-m resilience`` migration suite kills the
+  controller at each seam and asserts exactly that.
 
 Fault kinds: ``error`` raises :class:`FaultError`; ``latency`` sleeps
 ``ms``; ``hang`` sleeps ``ms`` too but defaults much longer — a hang is

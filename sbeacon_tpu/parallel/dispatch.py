@@ -32,6 +32,7 @@ multi-host *compute* (one jit program spanning hosts), see
 
 from __future__ import annotations
 
+import base64
 import collections
 import dataclasses
 import gzip
@@ -223,6 +224,16 @@ def _make_handler(
                     log.exception("worker reload failed")
                     self._send(500, {"error": f"{type(e).__name__}: {e}"})
                 return
+            if self.path.startswith("/migrate/"):
+                # live-migration artifact plane (ISSUE 16): manifest /
+                # fetch / adopt / drop, all POST (keep-alive-safe
+                # bodies), all inside the SAME worker-token boundary
+                # as /search and /reload — migration widens no trust
+                # surface. Served only when the engine grows the
+                # migration seams; a worker running an engine shape
+                # without them answers 404.
+                self._do_migrate(raw)
+                return
             if self.path == "/scan":
                 # /scan range-reads a CLIENT-SUPPLIED location (local path
                 # or URL) — an SSRF/arbitrary-read primitive if exposed.
@@ -327,6 +338,86 @@ def _make_handler(
                 self._send_bytes(200, dumps_index(shard))
             except Exception as e:
                 log.exception("worker slice scan failed")
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+
+        def _do_migrate(self, raw: bytes):
+            """Shard-migration artifact exchange: ``manifest`` lists a
+            dataset's base + standing-delta artifacts by epoch-ranged
+            fingerprint (the resume key), ``fetch`` streams one as a
+            raw npz blob, ``adopt`` installs a received artifact at its
+            ORIGINAL epoch, and ``drop`` retires the dataset after
+            cut-over. Every seam is getattr-guarded: a worker embedding
+            an engine without the migration entry points answers 404,
+            and the controller reports it instead of half-migrating."""
+            from ..index.columnar import dumps_index, loads_index
+
+            op = self.path[len("/migrate/"):]
+            try:
+                doc = json.loads(raw) if raw else {}
+                if not isinstance(doc, dict):
+                    raise ValueError("migrate body must be an object")
+            except Exception:
+                self._send(400, {"error": "bad migrate body"})
+                return
+            ds = str(doc.get("dataset") or "")
+            try:
+                if op == "manifest":
+                    fn = getattr(engine, "migration_manifest", None)
+                    if fn is None:
+                        self._send(
+                            404, {"error": "migration not supported"}
+                        )
+                    else:
+                        self._send(200, fn(ds))
+                elif op == "fetch":
+                    fn = getattr(engine, "export_artifact", None)
+                    if fn is None:
+                        self._send(
+                            404, {"error": "migration not supported"}
+                        )
+                        return
+                    shard = fn(
+                        ds,
+                        str(doc.get("vcf") or ""),
+                        epoch=doc.get("epoch"),
+                    )
+                    if shard is None:
+                        self._send(404, {"error": "artifact not found"})
+                    else:
+                        self._send_bytes(200, dumps_index(shard))
+                elif op == "adopt":
+                    shard = loads_index(
+                        base64.b64decode(doc.get("blob") or "")
+                    )
+                    if doc.get("kind") == "delta":
+                        fn = getattr(engine, "adopt_delta", None)
+                        if fn is None:
+                            self._send(
+                                404,
+                                {"error": "migration not supported"},
+                            )
+                            return
+                        adopted = fn(shard, int(doc.get("epoch") or 0))
+                        self._send(
+                            200, {"ok": True, "adopted": bool(adopted)}
+                        )
+                    else:
+                        engine.add_index(shard)
+                        self._send(200, {"ok": True, "adopted": True})
+                elif op == "drop":
+                    fn = getattr(engine, "drop_dataset", None)
+                    if fn is None:
+                        self._send(
+                            404, {"error": "migration not supported"}
+                        )
+                    else:
+                        self._send(
+                            200, {"ok": True, "shards": int(fn(ds))}
+                        )
+                else:
+                    self._send(404, {"error": "not found"})
+            except Exception as e:
+                log.exception("worker migrate %s failed", op)
                 self._send(500, {"error": f"{type(e).__name__}: {e}"})
 
     return Handler
@@ -563,6 +654,28 @@ def register_dispatch_metrics(registry, supplier) -> None:
         "datasets whose replicas advertise divergent fingerprints",
         fn=field("fleet_divergent"),
     )
+    # live shard migration (ISSUE 16): the controller's lifecycle series
+    registry.counter(
+        "migration.started",
+        "shard migrations started (copy phase entered)",
+        fn=field("migration_started"),
+    )
+    registry.counter(
+        "migration.completed",
+        "shard migrations completed through cut-over",
+        fn=field("migration_completed"),
+    )
+    registry.counter(
+        "migration.rolled_back",
+        "shard migrations aborted and rolled back (verify mismatch, "
+        "crash mid-protocol)",
+        fn=field("migration_rolled_back"),
+    )
+    registry.counter(
+        "migration.bytes_copied",
+        "artifact bytes streamed source->target by migration copies",
+        fn=field("migration_bytes_copied"),
+    )
 
 
 def _graft_worker_spans(wsp, url: str, meta, rtt_s: float) -> None:
@@ -632,26 +745,50 @@ def _graft_worker_spans(wsp, url: str, meta, rtt_s: float) -> None:
 
 def _fingerprint_freshness(fp: str) -> int:
     """Total indexed rows encoded in a per-dataset fingerprint (the
-    ``vcf|variant_count|call_count|n_rows`` parts joined by ``&``) —
+    ``vcf|variant_count|call_count|n_rows`` base parts and the
+    ``vcf#d<epoch>|rows`` standing delta-tail parts, joined by ``&``) —
     the 'newer copy' heuristic for divergent replicas: re-ingestion
     only grows a dataset's row count, so when two workers advertise
     the same dataset with different fingerprints the larger copy is
-    the one that saw the latest publish. Only the exact 4-field
-    per-dataset shape parses; anything else sorts oldest — in
-    particular a legacy worker's ENGINE-WIDE fallback string
-    (``ds|vcf|vc|cc|rows`` 5-field parts spanning its whole corpus)
-    must lose to real per-dataset identity, not out-freshen it by
-    summing rows across unrelated datasets."""
+    the one that saw the latest publish. Only the exact 4-field base
+    / 2-field epoch-tagged delta shapes parse; anything else sorts
+    oldest — in particular a legacy worker's ENGINE-WIDE fallback
+    string (``ds|vcf|vc|cc|rows`` 5-field parts spanning its whole
+    corpus) must lose to real per-dataset identity, not out-freshen
+    it by summing rows across unrelated datasets."""
     total = 0
     for part in fp.split("&"):
         fields = part.split("|")
-        if len(fields) != 4:
+        # delta-tail part: "vcf#d<epoch>|rows" (engine.py
+        # _rebuild_serving_state_locked) — the tail rows count toward
+        # freshness, so a deeper-tail copy out-freshens its base twin
+        if len(fields) == 2 and "#d" in fields[0]:
+            pass
+        elif len(fields) != 4:
             return -1
         try:
             total += int(fields[-1])
         except ValueError:
             return -1
     return total
+
+
+def _fingerprint_parts(
+    fp: str,
+) -> tuple[frozenset, frozenset] | None:
+    """(base parts, delta-tail parts) of a per-dataset fingerprint, or
+    None when any part fails the grammar (legacy engine-wide strings
+    stay unsplittable — they never enter the tail-superset relation)."""
+    bases, deltas = set(), set()
+    for part in fp.split("&"):
+        fields = part.split("|")
+        if len(fields) == 2 and "#d" in fields[0]:
+            deltas.add(part)
+        elif len(fields) == 4:
+            bases.add(part)
+        else:
+            return None
+    return frozenset(bases), frozenset(deltas)
 
 
 class ReplicaRouter:
@@ -684,12 +821,25 @@ class ReplicaRouter:
         self._lock = threading.Lock()
         self._table: dict[str, tuple[str, ...]] = {}
         self._rtts: dict[str, collections.deque] = {}
+        # migration cut-over pins: (dataset, url) pairs routed OUT.
+        # publish() filters them inside its own critical section, so a
+        # concurrent rediscovery republish can never resurrect a route
+        # the cut-over just retired (the half-routed state the
+        # migration invariant forbids).
+        self._retired: set[tuple[str, str]] = set()
 
     # -- table --------------------------------------------------------------
 
     def publish(self, table: dict[str, tuple[str, ...]]) -> None:
         new = {ds: tuple(urls) for ds, urls in table.items()}
         with self._lock:
+            if self._retired:
+                new = {
+                    ds: tuple(
+                        u for u in urls if (ds, u) not in self._retired
+                    )
+                    for ds, urls in new.items()
+                }
             changed = new != self._table
             self._table = new
         if changed:
@@ -713,6 +863,32 @@ class ReplicaRouter:
     def replica_count(self) -> int:
         with self._lock:
             return sum(len(urls) for urls in self._table.values())
+
+    def retire(self, dataset: str, url: str) -> None:
+        """Route ``url`` out of ``dataset``'s replica set ATOMICALLY:
+        the pin lands and the url leaves the live table inside ONE
+        critical section — the migration cut-over's 'retire the source
+        in the same critical section that bumps the table' contract.
+        Retired pairs also survive republish (see :meth:`publish`)."""
+        with self._lock:
+            self._retired.add((dataset, url))
+            urls = self._table.get(dataset)
+            if urls and url in urls:
+                self._table[dataset] = tuple(
+                    u for u in urls if u != url
+                )
+        publish_event("routing.route_retired", dataset=dataset, url=url)
+
+    def unretire(self, dataset: str, url: str) -> None:
+        """Lift a cut-over pin (rollback, or the source finished
+        dropping the dataset and no longer advertises it) — the next
+        publish may route the pair again if a worker advertises it."""
+        with self._lock:
+            self._retired.discard((dataset, url))
+
+    def retired(self) -> set[tuple[str, str]]:
+        with self._lock:
+            return set(self._retired)
 
     # -- RTT record ---------------------------------------------------------
 
@@ -1995,6 +2171,16 @@ class FleetView:
             for u, w in workers.items()
             if w.get("medianRttMs") is not None
         }
+        # live migrations ride the digest (ISSUE 16): phase + ages per
+        # in-flight migration, and the diagnosis names a STUCK one
+        # (phase age beyond the controller's stuck bound — the
+        # stalest-replica pattern applied to protocol progress)
+        migrations: list[dict] = []
+        stuck = None
+        ctl = getattr(self.engine, "migrations", None)
+        if ctl is not None:
+            migrations = ctl.status()
+            stuck = ctl.stuck()
         return {
             "intervalS": self.interval_s,
             "polls": polls,
@@ -2002,6 +2188,7 @@ class FleetView:
                 None if last is None else round(now - last, 1)
             ),
             "workers": workers,
+            "migrations": migrations,
             "diagnosis": {
                 "stalestReplica": stalest,
                 "hottestWorker": (
@@ -2013,6 +2200,7 @@ class FleetView:
                 "unreachableWorkers": sorted(
                     u for u, w in workers.items() if not w["reachable"]
                 ),
+                "stuckMigration": stuck,
             },
         }
 
@@ -2167,6 +2355,19 @@ class DistributedEngine:
             self,
             interval_s=getattr(obs_cfg, "fleet_digest_interval_s", 10.0),
         )
+        # per-worker in-flight /search legs (guarded by _sc_lock): the
+        # migration cut-over drains a retired source to zero before
+        # the source may drop the dataset — a leg started before the
+        # retire must finish against a worker that still has the rows
+        self._inflight: dict[str, int] = {}
+        # live shard migration (ISSUE 16): copy -> dual-serve ->
+        # canary-verify -> cut-over, exposed at /fleet/migrate.
+        # Constructed lazily-cheap like the fleet view; import here
+        # (not module top) because migration.py never imports dispatch
+        # but keeping the one-way edge explicit costs nothing.
+        from .migration import MigrationController
+
+        self.migrations = MigrationController(self)
 
     # headers are passed only when there is something to carry (a
     # configured token, an ambient trace id) AND the transport's
@@ -2233,6 +2434,7 @@ class DistributedEngine:
             self.mesh_tier.stats() if self.mesh_tier is not None else {}
         )
         fleet = self.fleet.stats()
+        mig = self.migrations.counters()
         with self._sc_lock:
             return {
                 "short_circuits": self._short_circuits,
@@ -2247,6 +2449,10 @@ class DistributedEngine:
                 "fleet_polls": fleet.get("polls", 0),
                 "fleet_reachable": fleet.get("reachable", 0),
                 "fleet_divergent": fleet.get("divergent", 0),
+                "migration_started": mig.get("started", 0),
+                "migration_completed": mig.get("completed", 0),
+                "migration_rolled_back": mig.get("rolled_back", 0),
+                "migration_bytes_copied": mig.get("bytes_copied", 0),
             }
 
     def route_table_age_s(self) -> float | None:
@@ -2289,6 +2495,7 @@ class DistributedEngine:
         and drop the pooled worker connections (engines are long-lived;
         call this when rebuilding one on config/route changes)."""
         self._closed.set()
+        self.migrations.close()
         if self.mesh_tier is not None:
             self.mesh_tier.close()
         self._pool.shutdown(wait=False, cancel_futures=True)
@@ -2314,16 +2521,49 @@ class DistributedEngine:
     @staticmethod
     def _group_replicas(ds: str, entries: list[tuple[str, str]]) -> tuple:
         """The replica urls for one dataset, grouped by per-dataset
-        fingerprint: only identical shard copies are interchangeable.
-        On a mismatch the newest copy wins (row-count freshness,
-        :func:`_fingerprint_freshness`) and the stale workers are
-        excluded from this dataset's routes — failover to a divergent
-        copy would silently change the answer mid-request."""
+        fingerprint: identical shard copies are interchangeable, and so
+        are **tail-superset** copies (ROADMAP 4a): same base artifacts,
+        delta tails forming a subset chain — a replica mid-rolling-
+        ingest (deeper tail) is a FRESHER copy of the same dataset,
+        not a divergence loser, and the migration dual-serve window
+        (target standing one delta behind the source for an instant)
+        rides the same relation. On a real mismatch the newest copy
+        wins (row-count freshness, :func:`_fingerprint_freshness`) and
+        the stale workers are excluded from this dataset's routes —
+        failover to a divergent copy would silently change the answer
+        mid-request."""
         by_fp: dict[str, list[str]] = {}
         for url, fp in entries:
             by_fp.setdefault(fp, []).append(url)
         if len(by_fp) == 1:
             return tuple(next(iter(by_fp.values())))
+        parts = {fp: _fingerprint_parts(fp) for fp in by_fp}
+        if all(p is not None for p in parts.values()):
+            bases = {p[0] for p in parts.values()}
+            tails = sorted(
+                (p[1] for p in parts.values()), key=len
+            )
+            chain = all(
+                a <= b for a, b in zip(tails, tails[1:])
+            )
+            if len(bases) == 1 and chain:
+                # every copy is routable; deepest tail first so the
+                # back-compat primary view (routes()[ds] = urls[0])
+                # points at the freshest copy
+                ordered = sorted(
+                    by_fp,
+                    key=lambda fp: (_fingerprint_freshness(fp), fp),
+                    reverse=True,
+                )
+                publish_event(
+                    "routing.tail_superset",
+                    dataset=ds,
+                    copies=len(by_fp),
+                    replicas=sum(len(u) for u in by_fp.values()),
+                )
+                return tuple(
+                    u for fp in ordered for u in sorted(by_fp[fp])
+                )
         win = max(by_fp, key=lambda fp: (_fingerprint_freshness(fp), fp))
         losers = sorted(
             u for fp, urls in by_fp.items() if fp != win for u in urls
@@ -2430,7 +2670,10 @@ class DistributedEngine:
             self._fingerprints.update(fps)
             self._last_publish_mono = time.monotonic()
             self.router.publish(table)
-        return table
+        # the router's view, not the locally computed table: publish()
+        # filters migration cut-over pins inside its critical section,
+        # and callers must never see a retired route resurrected
+        return self.router.table()
 
     def replica_table(
         self, refresh: bool = False
@@ -2450,6 +2693,41 @@ class DistributedEngine:
             for ds, urls in self.replica_table(refresh).items()
             if urls
         }
+
+    # -- fleet membership (the migration grow/shrink seam) -------------------
+
+    def add_worker(self, url: str) -> bool:
+        """Admit ``url`` to the fleet and run a discovery pass so its
+        datasets enter the routing table (the migration dual-serve
+        publish). Returns False when already a member."""
+        with self._routes_lock:
+            if url in self.worker_urls:
+                return False
+            self.worker_urls.append(url)
+        # discovery takes _routes_lock itself — must run outside it
+        self._discover()
+        return True
+
+    def remove_worker(self, url: str) -> bool:
+        """Drop ``url`` from the fleet and republish routes without
+        its contribution (its last-known-good retention included)."""
+        with self._routes_lock:
+            if url not in self.worker_urls:
+                return False
+            self.worker_urls.remove(url)
+            self._last_seen.pop(url, None)
+            self._fingerprints.pop(url, None)
+            self._reachable.discard(url)
+            self._retention_warned.discard(url)
+        self._discover()
+        return True
+
+    def inflight(self, url: str) -> int:
+        """In-flight /search legs against ``url`` right now — the
+        cut-over drain signal (a retired source must answer its
+        started legs before it may drop the dataset)."""
+        with self._sc_lock:
+            return self._inflight.get(url, 0)
 
     # -- background rediscovery --------------------------------------------
 
@@ -2547,6 +2825,27 @@ class DistributedEngine:
         return self._call_worker_traced(url, payload, note_rtt=False)
 
     def _call_worker_traced(
+        self, url: str, payload: VariantQueryPayload, deadline=None,
+        *, note_rtt: bool = True,
+    ):
+        # in-flight leg accounting brackets the WHOLE leg (retries
+        # included): the migration cut-over drains inflight(url) to
+        # zero before the retired source may drop the dataset
+        with self._sc_lock:
+            self._inflight[url] = self._inflight.get(url, 0) + 1
+        try:
+            return self._call_worker_leg(
+                url, payload, deadline, note_rtt=note_rtt
+            )
+        finally:
+            with self._sc_lock:
+                n = self._inflight.get(url, 0) - 1
+                if n <= 0:
+                    self._inflight.pop(url, None)
+                else:
+                    self._inflight[url] = n
+
+    def _call_worker_leg(
         self, url: str, payload: VariantQueryPayload, deadline=None,
         *, note_rtt: bool = True,
     ):

@@ -65,6 +65,7 @@ PROBE_ROUTE_LABELS = frozenset({
     "debug.status",
     "device.status",
     "fleet.status",
+    "fleet.migrations",
 })
 
 #: probe labels that are NOT auth/admission-bypass transport paths:

@@ -1,0 +1,492 @@
+"""Live shard-migration resilience suite (ISSUE 16).
+
+The fast ``-m resilience`` tests cover the protocol's invariant — at
+every instant at least one routable, fresh copy serves the dataset —
+by crashing the controller at each of its four ``fault_point`` seams
+(``migration:copy`` / ``dual_serve`` / ``verify`` / ``cutover``) and
+asserting the fleet's answers stay byte-identical to an unmigrated
+oracle, plus the verify-mismatch abort, crash-resume via the manifest
+diff, and the stuck-migration diagnosis. The ``slow`` chaos soak runs
+mixed API traffic while datasets migrate, kills a replica
+mid-migration, grows then shrinks the fleet, and requires zero 5xx
+and post-soak parity against a pre-soak oracle.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import re
+import threading
+import time
+
+import pytest
+
+from sbeacon_tpu.config import (
+    BeaconConfig,
+    EngineConfig,
+    ObservabilityConfig,
+    ResilienceConfig,
+    StorageConfig,
+)
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.harness import faults
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.parallel.dispatch import DistributedEngine, WorkerServer
+from sbeacon_tpu.parallel.migration import Migration, MigrationError
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+resilience = pytest.mark.resilience
+
+SEAMS = (
+    "migration:copy",
+    "migration:dual_serve",
+    "migration:verify",
+    "migration:cutover",
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_leftover_faults():
+    yield
+    faults.uninstall()
+
+
+def _records(seed=5, n=200):
+    rng = random.Random(seed)
+    return random_records(rng, chrom="21", n=n, n_samples=2)
+
+
+def _shard(recs, ds="mg"):
+    return build_index(
+        recs,
+        dataset_id=ds,
+        vcf_location=f"synthetic://{ds}",
+        sample_names=["A", "B"],
+    )
+
+
+def _engine(cfg=None):
+    return VariantEngine(
+        cfg or BeaconConfig(engine=EngineConfig(microbatch=False))
+    )
+
+
+def _payload(ds_list, granularity="count"):
+    return VariantQueryPayload(
+        dataset_ids=ds_list,
+        reference_name="21",
+        start_min=1,
+        start_max=1 << 30,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        requested_granularity=granularity,
+        include_datasets="HIT",
+    )
+
+
+def _dumps(responses):
+    return sorted(r.dumps() for r in responses)
+
+
+@pytest.fixture()
+def fleet():
+    """Source worker serving base + standing delta tail, an EMPTY
+    target worker (not yet a fleet member), a coordinator routing only
+    the source, and an unmigrated oracle engine for parity checks."""
+    recs = _records()
+    extra = _records(seed=9, n=40)
+    src_eng = _engine()
+    src_eng.add_index(_shard(recs))
+    src_eng.add_delta(_shard(extra))
+    tgt_eng = _engine()
+    w_src = WorkerServer(src_eng).start_background()
+    w_tgt = WorkerServer(tgt_eng).start_background()
+    dist = DistributedEngine([w_src.address])
+    dist.replica_table()
+    oracle = _engine()
+    oracle.add_index(_shard(recs))
+    oracle.add_delta(_shard(extra))
+    try:
+        yield dist, w_src, w_tgt, src_eng, tgt_eng, oracle
+    finally:
+        dist.close()
+        w_src.shutdown()
+        w_tgt.shutdown()
+
+
+# -- the protocol --------------------------------------------------------------
+
+
+@resilience
+def test_migrate_happy_path_byte_identical(fleet):
+    """copy -> dual-serve -> verify -> cut-over end to end: the
+    dataset moves source -> target, the source's copy is dropped, and
+    the fleet's answers stay byte-identical to an engine that never
+    migrated anything."""
+    dist, w_src, w_tgt, src_eng, tgt_eng, oracle = fleet
+    m = dist.migrations.run("mg", w_src.address, w_tgt.address)
+    assert m.phase == "completed"
+    assert m.artifacts_copied == 2  # base + one delta
+    assert m.bytes_copied > 0
+    assert m.verify_rounds == 3  # default BEACON_MIGRATION_VERIFY_ROUNDS
+
+    table = dist.replica_table(refresh=True)
+    assert table["mg"] == (w_tgt.address,)
+    # the source actually dropped its copy (not just unrouted)
+    assert src_eng.migration_manifest("mg")["artifacts"] == []
+    # and the retire pin was lifted after the drop: a future
+    # re-ingest on the source must be routable again
+    assert dist.router.retired() == set()
+
+    for gran in ("boolean", "count", "record"):
+        p = _payload(["mg"], gran)
+        assert _dumps(dist.search(p)) == _dumps(oracle.search(p))
+
+    counters = dist.migrations.counters()
+    assert counters["started"] == 1
+    assert counters["completed"] == 1
+    assert counters["rolled_back"] == 0
+    assert counters["bytes_copied"] == m.bytes_copied
+    # dispatch_stats -> register_dispatch_metrics read the same values
+    stats = dist.dispatch_stats()
+    assert stats["migration_completed"] == 1
+    assert stats["migration_bytes_copied"] == m.bytes_copied
+
+
+@resilience
+def test_copy_resumes_from_adopted_artifacts(fleet):
+    """A target already holding some artifacts (a crashed earlier
+    copy) is resumed, not restarted: the manifest diff skips what was
+    adopted and streams only the rest."""
+    dist, w_src, w_tgt, src_eng, tgt_eng, oracle = fleet
+    # simulate the partial copy a copy-phase crash leaves behind:
+    # the base made it across, the delta tail did not
+    tgt_eng.add_index(_shard(_records()))
+    m = dist.migrations.run("mg", w_src.address, w_tgt.address)
+    assert m.phase == "completed"
+    assert m.artifacts_skipped == 1  # the base: already adopted
+    assert m.artifacts_copied == 1  # the delta: streamed now
+    p = _payload(["mg"])
+    assert _dumps(dist.search(p)) == _dumps(oracle.search(p))
+
+
+@resilience
+@pytest.mark.parametrize("seam", SEAMS)
+def test_seam_crash_never_half_routes(fleet, seam):
+    """Kill the controller at each phase-entry seam: every crash must
+    leave the source routed and serving byte-identical answers — a
+    copy crash resumes on re-run, later crashes roll the target back
+    out."""
+    dist, w_src, w_tgt, src_eng, tgt_eng, oracle = fleet
+    faults.install(
+        {"seed": 3, "rules": [{"site": seam, "kind": "error", "rate": 1.0}]}
+    )
+    with pytest.raises(MigrationError):
+        dist.migrations.run("mg", w_src.address, w_tgt.address)
+    faults.uninstall()
+
+    m = dist.migrations.status()[-1]
+    if seam == "migration:copy":
+        # abandoned, never rolled back: adopted artifacts stay on the
+        # target so a re-run resumes
+        assert m["phase"] == "failed"
+        assert dist.migrations.counters()["rolled_back"] == 0
+    else:
+        assert m["phase"] == "rolled_back"
+        assert dist.migrations.counters()["rolled_back"] == 1
+        # the target's copy was dropped and it is not routed
+        assert tgt_eng.migration_manifest("mg")["artifacts"] == []
+
+    # the invariant: source still routed, answers byte-identical
+    table = dist.replica_table(refresh=True)
+    assert table["mg"] == (w_src.address,)
+    p = _payload(["mg"])
+    assert _dumps(dist.search(p)) == _dumps(oracle.search(p))
+
+    # and a re-run (faults gone) completes — resume for the copy
+    # crash, a fresh migration after a rollback
+    m2 = dist.migrations.run("mg", w_src.address, w_tgt.address)
+    assert m2.phase == "completed"
+    assert _dumps(dist.search(p)) == _dumps(oracle.search(p))
+    assert dist.replica_table(refresh=True)["mg"] == (w_tgt.address,)
+
+
+@resilience
+def test_verify_mismatch_aborts_and_rolls_back(fleet):
+    """A target whose answers diverge from the source (corrupted here
+    at verify entry) must never be promoted: the canary-verify round
+    aborts the migration, the target is routed out and dropped, and
+    the source keeps serving the true answers."""
+    dist, w_src, w_tgt, src_eng, tgt_eng, oracle = fleet
+
+    def corrupt(phase, m):
+        if phase == "verify":
+            # rows the source never served: counts diverge while the
+            # artifact manifest still covers the source's (a superset)
+            tgt_eng.add_delta(_shard(_records(seed=77, n=25)))
+
+    with pytest.raises(MigrationError, match="mismatch"):
+        dist.migrations.run(
+            "mg", w_src.address, w_tgt.address, on_phase=corrupt
+        )
+    m = dist.migrations.status()[-1]
+    assert m["phase"] == "rolled_back"
+    assert "mismatch" in (m["error"] or "")
+    assert dist.migrations.counters()["rolled_back"] == 1
+    table = dist.replica_table(refresh=True)
+    assert table["mg"] == (w_src.address,)
+    p = _payload(["mg"])
+    assert _dumps(dist.search(p)) == _dumps(oracle.search(p))
+
+
+@resilience
+def test_migrate_validation_and_disable(fleet):
+    dist, w_src, w_tgt, *_ = fleet
+    with pytest.raises(MigrationError, match="same worker"):
+        dist.migrations.run("mg", w_src.address, w_src.address)
+    with pytest.raises(MigrationError, match="needs dataset"):
+        dist.migrations.run("", w_src.address, w_tgt.address)
+    dist.config = BeaconConfig(
+        observability=ObservabilityConfig(migration_enabled=False)
+    )
+    with pytest.raises(MigrationError, match="disabled"):
+        dist.migrations.run("mg", w_src.address, w_tgt.address)
+
+
+@resilience
+def test_stuck_migration_diagnosed_in_fleet_digest(fleet):
+    """A phase that outlives its bound (2x the measured copy time for
+    post-copy phases) is named by stuck() and surfaces in the fleet
+    digest's diagnosis — the operator sees WHICH migration wedged."""
+    dist, *_ = fleet
+    now = time.monotonic()
+    wedged = Migration(
+        id="mig-wedged",
+        dataset="mg",
+        source="http://a:1",
+        target="http://b:1",
+        phase="verify",
+        started_mono=now - 100.0,
+        phase_mono=now - 100.0,
+        copy_s=1.0,
+    )
+    with dist.migrations._lock:
+        dist.migrations._migrations.append(wedged)
+    s = dist.migrations.stuck()
+    assert s is not None
+    assert s["id"] == "mig-wedged"
+    assert s["phase"] == "verify"
+    assert s["phaseAgeS"] > s["boundS"]
+    snap = dist.fleet.snapshot()
+    assert snap["diagnosis"]["stuckMigration"]["id"] == "mig-wedged"
+    assert any(
+        mm["id"] == "mig-wedged" for mm in snap["migrations"]
+    )
+
+
+# -- the chaos soak: migrate under load ---------------------------------------
+
+
+def _hit_alt(rec):
+    for a, ac in zip(rec.alts, rec.effective_ac()):
+        if re.fullmatch(r"[ACGTN]+", a) and ac > 0:
+            return a
+    return None
+
+
+def _gv_query(rec):
+    return {
+        "query": {
+            "requestedGranularity": "boolean",
+            "requestParameters": {
+                "assemblyId": "GRCh38",
+                "referenceName": "21",
+                "start": [max(0, rec.pos - 1)],
+                "end": [rec.pos + len(rec.ref) + 5],
+                "alternateBases": _hit_alt(rec),
+            },
+        }
+    }
+
+
+@pytest.mark.slow
+def test_chaos_soak_migrate_under_load_zero_5xx(tmp_path):
+    """Mixed boolean/count traffic runs while TWO datasets migrate to
+    a worker that joins the fleet mid-soak; another replica is KILLED
+    mid-migration; the drained source leaves the fleet afterwards
+    (grow -> shrink). Requirements: zero 5xx across the whole soak,
+    both migrations complete, and the post-soak answers are
+    byte-identical to a pre-soak oracle."""
+    from sbeacon_tpu.api import BeaconApp
+
+    recs0 = _records(seed=21, n=240)
+    extra0 = _records(seed=22, n=40)
+    recs1 = _records(seed=23, n=200)
+
+    def _load(eng, ds, recs, extra=None):
+        eng.add_index(_shard(recs, ds))
+        if extra is not None:
+            eng.add_delta(_shard(extra, ds))
+
+    # w1: d0 (base + tail) and d1; w2: replica of d0; w3: empty target
+    e1 = _engine()
+    _load(e1, "d0", recs0, extra0)
+    _load(e1, "d1", recs1)
+    e2 = _engine()
+    _load(e2, "d0", recs0, extra0)
+    e3 = _engine()
+    w1 = WorkerServer(e1).start_background()
+    w2 = WorkerServer(e2).start_background()
+    w3 = WorkerServer(e3).start_background()
+
+    cfg = BeaconConfig(
+        storage=StorageConfig(root=tmp_path / "coord"),
+        engine=EngineConfig(use_mesh=False, microbatch=False),
+        resilience=ResilienceConfig(),
+    )
+    cfg.storage.ensure()
+    dist = DistributedEngine(
+        [w1.address, w2.address],
+        local=VariantEngine(cfg),
+        config=cfg,
+        retries=0,
+        timeout_s=10.0,
+    )
+    app = BeaconApp(cfg, engine=dist)
+    app.store.upsert(
+        "datasets",
+        [
+            {
+                "id": ds,
+                "name": ds,
+                "_assemblyId": "GRCh38",
+                "_vcfLocations": [f"synthetic://{ds}"],
+            }
+            for ds in ("d0", "d1")
+        ],
+    )
+    dist.replica_table()
+
+    # the pre-soak oracle: both datasets, never migrated
+    oracle = _engine()
+    _load(oracle, "d0", recs0, extra0)
+    _load(oracle, "d1", recs1)
+    pre = {
+        ds: _dumps(oracle.search(_payload([ds])))
+        for ds in ("d0", "d1")
+    }
+
+    qrecs = [r for r in recs0 if _hit_alt(r)]
+    assert qrecs
+    statuses: list[int] = []
+    bad: list = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def client(k: int):
+        rng = random.Random(900 + k)
+        while not stop.is_set():
+            q = _gv_query(qrecs[rng.randrange(len(qrecs))])
+            if rng.random() < 0.5:
+                q["query"]["requestedGranularity"] = "count"
+            status, body = app.handle(
+                "POST",
+                "/g_variants",
+                body=q,
+                headers={"X-Beacon-Deadline": "15"},
+            )
+            with lock:
+                statuses.append(status)
+                if status >= 500:
+                    bad.append((status, body))
+            time.sleep(0.005)
+
+    threads = [
+        threading.Thread(target=client, args=(k,), daemon=True)
+        for k in range(6)
+    ]
+    for t in threads:
+        t.start()
+
+    def wait_phase(mig_id, timeout_s=60.0):
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            _, doc = app.handle("GET", "/fleet/migrations")
+            for mm in doc["migrations"]:
+                if mm["id"] == mig_id and mm["phase"] in (
+                    "completed",
+                    "rolled_back",
+                    "failed",
+                ):
+                    return mm
+            time.sleep(0.05)
+        raise AssertionError(f"{mig_id} never finished: {doc}")
+
+    try:
+        time.sleep(0.3)  # traffic flowing before the fleet changes
+        # migration 1 (through the API): d0 moves w1 -> w3 — the
+        # fleet GROWS when dual-serve admits w3
+        st, doc = app.handle(
+            "POST",
+            "/fleet/migrate",
+            body={
+                "dataset": "d0",
+                "source": w1.address,
+                "target": w3.address,
+            },
+        )
+        assert st == 202, doc
+        mig1 = doc["migrationId"]
+        # chaos: kill d0's OTHER replica mid-migration — traffic must
+        # keep answering via failover while the copy proceeds
+        time.sleep(0.2)
+        w2.shutdown()
+        mm1 = wait_phase(mig1)
+        assert mm1["phase"] == "completed", mm1
+
+        # migration 2: d1 moves w1 -> w3 as well, draining w1
+        st, doc = app.handle(
+            "POST",
+            "/fleet/migrate",
+            body={
+                "dataset": "d1",
+                "source": w1.address,
+                "target": w3.address,
+            },
+        )
+        assert st == 202, doc
+        mm2 = wait_phase(doc["migrationId"])
+        assert mm2["phase"] == "completed", mm2
+
+        # the fleet SHRINKS: the drained source leaves
+        assert dist.remove_worker(w1.address)
+        time.sleep(0.3)  # traffic over the shrunken fleet
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    assert statuses, "no traffic recorded"
+    assert not bad, f"5xx during soak: {bad[:5]} of {len(bad)}"
+
+    # post-soak parity: byte-identical to the pre-soak oracle
+    for ds in ("d0", "d1"):
+        assert _dumps(dist.search(_payload([ds]))) == pre[ds], ds
+    # the survivors: d1 only on w3; d0 on w3 (w2 is dead but its
+    # last-known route may be retained — the failover path owns it)
+    table = dist.replica_table(refresh=True)
+    assert w3.address in table["d0"]
+    assert w1.address not in table["d0"]
+    assert table["d1"] == (w3.address,)
+    counters = json.loads(
+        json.dumps(dist.migrations.counters())
+    )  # json-clean
+    assert counters["completed"] == 2
+    assert counters["rolled_back"] == 0
+
+    dist.close()
+    w1.shutdown()
+    w3.shutdown()

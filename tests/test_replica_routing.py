@@ -888,3 +888,163 @@ def test_chaos_soak_kill_and_restart_replica_zero_5xx(tmp_path):
     w2.shutdown()
     dist.close()
     app.close()
+
+
+# -- tail-superset relation & retire pins (ISSUE 16) ---------------------------
+
+
+@resilience
+def test_tail_superset_copies_stay_routable():
+    """A replica whose delta tail is a SUBSET of another's (same base)
+    is a valid, slightly-stale copy — BOTH stay routable (the dual-serve
+    window of a live migration), ordered deepest tail first, and the
+    primary route's answer carries the tail rows (proven fresh against
+    an oracle serving base + tail)."""
+    recs = _records()
+    extra = _records(seed=11, n=30)
+    deep = _replica_engine(recs)  # base + standing delta tail
+    deep.add_delta(_shard(extra))
+    shallow = _replica_engine(recs)  # base only: the lagging copy
+    w_deep = WorkerServer(deep).start_background()
+    w_shal = WorkerServer(shallow).start_background()
+    dist = DistributedEngine([w_shal.address, w_deep.address])
+    try:
+        table = dist.replica_table()
+        assert set(table["rz"]) == {w_deep.address, w_shal.address}
+        # deepest tail first: the back-compat primary view routes fresh
+        assert table["rz"][0] == w_deep.address
+        assert dist.routes()["rz"] == w_deep.address
+        oracle = _replica_engine(recs)
+        oracle.add_delta(_shard(extra))
+        p = _payload(["rz"])
+        want = sorted(r.dumps() for r in oracle.search(p))
+        got = sorted(
+            r.dumps()
+            for r in dist.call_replica(dist.routes()["rz"], p)
+        )
+        assert got == want
+    finally:
+        dist.close()
+        w_deep.shutdown()
+        w_shal.shutdown()
+
+
+@resilience
+def test_tail_superset_chain_orders_deepest_first():
+    """Three copies forming a subset chain (base ⊆ base+d1 ⊆
+    base+d1+d2) all route, deepest first; _fingerprint_parts parses
+    the grammar and rejects garbage."""
+    from sbeacon_tpu.parallel.dispatch import _fingerprint_parts
+
+    assert _fingerprint_parts("v|1|2|30&v#d1|5") == (
+        frozenset({"v|1|2|30"}),
+        frozenset({"v#d1|5"}),
+    )
+    assert _fingerprint_parts("garbage") is None
+
+    fps = {
+        "http://w0:1": "v|1|2|100",
+        "http://w1:1": "v|1|2|100&v#d1|5",
+        "http://w2:1": "v|1|2|100&v#d1|5&v#d2|7",
+    }
+
+    def get(url, timeout_s, headers=None):
+        base = url.rsplit("/", 1)[0]
+        return 200, {
+            "datasets": ["ds"],
+            "fingerprint": "x",
+            "dataset_fingerprints": {"ds": fps[base]},
+        }
+
+    def post(url, doc, timeout_s, headers=None):
+        return 200, {"responses": []}
+
+    dist = DistributedEngine(
+        sorted(fps), retries=0, post=post, get=get
+    )
+    try:
+        table = dist.replica_table()["ds"]
+        assert set(table) == set(fps)
+        assert table[0] == "http://w2:1"
+    finally:
+        dist.close()
+
+
+@resilience
+def test_tail_superset_requires_matching_base():
+    """A different BASE part set is still divergence (not a lagging
+    copy): only the winner routes, as before ISSUE 16."""
+
+    def get(url, timeout_s, headers=None):
+        if "deep" in url:
+            return 200, {
+                "datasets": ["ds"],
+                "fingerprint": "f1",
+                "dataset_fingerprints": {"ds": "v|1|2|100&v#d1|5"},
+            }
+        return 200, {
+            "datasets": ["ds"],
+            "fingerprint": "f2",
+            "dataset_fingerprints": {"ds": "w|1|2|90&w#d1|5"},
+        }
+
+    def post(url, doc, timeout_s, headers=None):
+        return 200, {"responses": []}
+
+    dist = DistributedEngine(
+        ["http://deep:1", "http://othr:1"], retries=0, post=post, get=get
+    )
+    try:
+        assert dist.replica_table()["ds"] == ("http://deep:1",)
+    finally:
+        dist.close()
+
+
+@resilience
+def test_breaker_open_replica_readmitted_after_discovery(replica_pair):
+    """Re-admission audit: a replica whose circuit opened (it died,
+    then came back) must re-enter routing after a discovery pass — the
+    breaker must not blacklist a healthy worker forever."""
+    _, w1, w2 = replica_pair
+    dist = DistributedEngine([w1.address, w2.address])
+    try:
+        dist.replica_table()
+        for _ in range(10):
+            dist.breaker.record_failure(w2.address)
+        assert not dist.router.live(w2.address)
+        assert all(
+            dist.router.pick("rz") == w1.address for _ in range(20)
+        )
+        # the worker answers /datasets again: discovery must revive it
+        dist.replica_table(refresh=True)
+        assert dist.router.live(w2.address)
+        assert w2.address in {
+            dist.router.pick("rz") for _ in range(50)
+        }
+    finally:
+        dist.close()
+
+
+@resilience
+def test_retired_route_survives_republish(replica_pair):
+    """retire() pins (dataset, url) out in the same critical section
+    that bumps the table, and the pin holds across a rediscovery
+    republish (the cut-over invariant); unretire() readmits the pair
+    on the next publish."""
+    _, w1, w2 = replica_pair
+    dist = DistributedEngine([w1.address, w2.address])
+    try:
+        assert len(dist.replica_table()["rz"]) == 2
+        dist.router.retire("rz", w2.address)
+        assert dist.router.table()["rz"] == (w1.address,)
+        # rediscovery republishes the full worker list — the retired
+        # pair must NOT resurrect
+        assert dist.replica_table(refresh=True)["rz"] == (w1.address,)
+        assert ("rz", w2.address) in dist.router.retired()
+        dist.router.unretire("rz", w2.address)
+        assert set(dist.replica_table(refresh=True)["rz"]) == {
+            w1.address,
+            w2.address,
+        }
+    finally:
+        dist.close()

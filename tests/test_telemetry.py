@@ -241,6 +241,10 @@ GOLDEN_METRICS = [
     "device.evaluated_pairs",
     "device.pad_waste",
     "device.mid_request_compiles",
+    "migration.started",
+    "migration.completed",
+    "migration.rolled_back",
+    "migration.bytes_copied",
 ]
 
 
@@ -788,3 +792,61 @@ def test_annotation_key_lint_catches_violations():
     assert any("lane" in e for e in errors)
     assert akl_lint({"tenant": ["a.py:1"]}, None)  # missing registry
     assert akl_lint({}, registry)  # no call sites at all
+
+
+# -- fault-seam lint (ISSUE 16 satellite) --------------------------------------
+
+
+@obs
+def test_fault_seam_lint():
+    """Every fault_point() site in sbeacon_tpu/ must have a row in the
+    DEPLOYMENT.md fault-plan table and vice versa — two-way parity, so
+    a chaos plan can only name seams the code hits and the table stays
+    the complete seam inventory."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_fault_seams.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@obs
+def test_fault_seam_lint_catches_violations(tmp_path, monkeypatch):
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import check_fault_seams as cfs
+    finally:
+        sys.path.pop(0)
+
+    pkg = tmp_path / "sbeacon_tpu"
+    (pkg / "harness").mkdir(parents=True)
+    (pkg / "harness" / "faults.py").write_text(
+        "def fault_point(site, detail=''):\n    pass\n"
+    )
+    (pkg / "mod.py").write_text(
+        "from .harness.faults import fault_point\n"
+        "def f(name):\n"
+        "    fault_point('documented.site', 'd')\n"
+        "    fault_point('rogue.site')\n"
+        "    fault_point(name)\n"  # computed: unlintable
+    )
+    doc = tmp_path / "DEPLOYMENT.md"
+    doc.write_text(
+        "<!-- fault-plan:begin -->\n"
+        "| Site | Where | detail |\n"
+        "|---|---|---|\n"
+        "| `documented.site` | mod.py | — |\n"
+        "| `ghost.site` | nowhere | — |\n"
+        "<!-- fault-plan:end -->\n"
+    )
+    monkeypatch.setattr(cfs, "REPO", tmp_path)
+    monkeypatch.setattr(cfs, "PKG", pkg)
+    monkeypatch.setattr(cfs, "DEPLOYMENT", doc)
+    errors = cfs.lint()
+    assert any("rogue.site" in e for e in errors)
+    assert any("ghost.site" in e for e in errors)
+    assert any("string literal" in e for e in errors)
+    assert not any("documented.site" in e for e in errors)

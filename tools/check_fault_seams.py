@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Two-way parity between fault_point() seams and the DEPLOYMENT.md
+fault-plan table.
+
+A chaos plan (``BEACON_FAULT_PLAN``) can only name sites the code
+actually hits, and an operator reading the fault-plan table must be
+able to trust it is the complete seam inventory. Both directions rot
+silently: a new ``fault_point("x", ...)`` call without a table row
+ships an undocumented chaos surface; a table row that outlives its
+call site documents a knob that does nothing. This lint walks every
+``fault_point`` call in ``sbeacon_tpu/`` by AST (no imports — the
+package may need JAX) and diffs the literal site names against the
+rows between the ``<!-- fault-plan:begin/end -->`` markers.
+
+Also enforced: every ``fault_point`` first argument must be a string
+LITERAL. A computed site name cannot be cross-checked against the
+table (and would let a typo mint an unplannable site), so it fails.
+
+Run directly (``python tools/check_fault_seams.py``) or via its tier-1
+wrapper in tests/test_telemetry.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+PKG = REPO / "sbeacon_tpu"
+DEPLOYMENT = REPO / "DEPLOYMENT.md"
+
+BEGIN = "<!-- fault-plan:begin -->"
+END = "<!-- fault-plan:end -->"
+
+#: first backticked cell of a table row names the site
+ROW_RE = re.compile(r"^\|\s*`([a-z0-9_.:]+)`")
+
+
+def _is_fault_point(func: ast.expr) -> bool:
+    if isinstance(func, ast.Name):
+        return func.id == "fault_point"
+    if isinstance(func, ast.Attribute):
+        return func.attr == "fault_point"
+    return False
+
+
+def code_sites() -> tuple[dict[str, list[str]], list[str]]:
+    """{site: [file:line, ...]} for every fault_point call, plus
+    errors for calls whose site is not a string literal."""
+    sites: dict[str, list[str]] = {}
+    errors: list[str] = []
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(REPO)
+        tree = ast.parse(path.read_text(), filename=str(rel))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _is_fault_point(node.func)
+            ):
+                continue
+            where = f"{rel}:{node.lineno}"
+            # skip the definition module's own internals (the hook
+            # itself takes `site` as a parameter, not a literal)
+            if rel == Path("sbeacon_tpu/harness/faults.py"):
+                continue
+            if not node.args:
+                errors.append(f"{where}: fault_point() with no site")
+                continue
+            arg = node.args[0]
+            if not (
+                isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)
+            ):
+                errors.append(
+                    f"{where}: fault_point site must be a string "
+                    "literal (a computed site cannot be checked "
+                    "against the fault-plan table)"
+                )
+                continue
+            sites.setdefault(arg.value, []).append(where)
+    return sites, errors
+
+
+def documented_sites() -> tuple[set[str], list[str]]:
+    text = DEPLOYMENT.read_text()
+    if BEGIN not in text or END not in text:
+        return set(), [
+            f"DEPLOYMENT.md: missing {BEGIN} / {END} markers around "
+            "the fault-plan table"
+        ]
+    block = text.split(BEGIN, 1)[1].split(END, 1)[0]
+    sites: set[str] = set()
+    for line in block.splitlines():
+        mo = ROW_RE.match(line.strip())
+        if mo:
+            sites.add(mo.group(1))
+    if not sites:
+        return set(), [
+            "DEPLOYMENT.md: fault-plan table has no site rows "
+            "between its markers"
+        ]
+    return sites, []
+
+
+def lint() -> list[str]:
+    sites, errors = code_sites()
+    documented, doc_errors = documented_sites()
+    errors.extend(doc_errors)
+    if doc_errors:
+        return errors
+    for site in sorted(set(sites) - documented):
+        errors.append(
+            f"undocumented fault site {site!r} "
+            f"(hit at {', '.join(sites[site])}) — add a row to the "
+            "DEPLOYMENT.md fault-plan table"
+        )
+    for site in sorted(documented - set(sites)):
+        errors.append(
+            f"DEPLOYMENT.md fault-plan table documents {site!r} but "
+            "no fault_point() call hits it — remove the row or "
+            "restore the seam"
+        )
+    return errors
+
+
+def main() -> int:
+    errors = lint()
+    for e in errors:
+        print(f"ERROR: {e}")
+    if errors:
+        return 1
+    sites, _ = code_sites()
+    n_calls = sum(len(v) for v in sites.values())
+    print(
+        f"ok: {len(sites)} fault sites ({n_calls} call sites) match "
+        "the DEPLOYMENT.md fault-plan table"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
