@@ -2960,10 +2960,10 @@ def config18_device():
     decomposition + padding waste per program family under a
     config12-style mixed interactive/bulk load, with the
     /device/status snapshot embedded in the record. The padding-waste
-    ratio is the structural metric the ROADMAP item 1
-    owner-sharded-output follow-up will be judged against, and
-    mid_request_compiles == 0 is the warmup-coverage contract under
-    real concurrency."""
+    ratio is the structural metric the roofline campaign is judged
+    against (config21 records the before/after under the adaptive
+    ladder), and mid_request_compiles == 0 is the warmup-coverage
+    contract under real concurrency."""
     import random as _random
     import tempfile
     import threading
@@ -3560,6 +3560,224 @@ def config20_migrate():
     return out
 
 
+def _roofline_probe() -> dict:
+    """Roofline campaign probe (ISSUE 17), structural asserts only —
+    never wall-clock (config13 virtual-device honesty rule).
+
+    Leg 1/2 — the SAME gap-traffic burst mix (coalesced bulk batches
+    landing between the legacy 8 and 64 rungs, the cells PR 14's
+    recorder measured worst) served under the legacy ``BATCH_TIERS``
+    ladder and the adaptive ``TierLadder``, each under a fresh flight
+    recorder with every active rung warmed first. Asserts the worst
+    padding-waste cell at least halves and that BOTH legs record zero
+    mid-request compiles (every rung the ladder can emit was warmed).
+
+    Leg 3 — owner-sharded vs replicated mesh output fetch over a
+    skewed batch (every query targeting one device's shards — the
+    shape where replicated fetch is pure waste): asserts the
+    owner-sharded path fetches at most half the bytes per query."""
+    import random as _random
+
+    import sbeacon_tpu.telemetry as _tel
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ops.kernel import (
+        BATCH_TIERS,
+        FusedDeviceIndex,
+        QuerySpec,
+        TierLadder,
+        active_ladder,
+        encode_queries,
+        run_queries,
+        set_active_ladder,
+    )
+    from sbeacon_tpu.telemetry import (
+        DeviceFlightRecorder,
+        device_warmup_phase,
+    )
+    from sbeacon_tpu.testing import random_records
+
+    n_shards = 4
+    shards = [
+        build_index(
+            random_records(
+                _random.Random(2100 + d), chrom="1", n=1500, n_samples=2
+            ),
+            dataset_id=f"rf{d}",
+            vcf_location=f"rf{d}.vcf.gz",
+            sample_names=["S0", "S1"],
+        )
+        for d in range(n_shards)
+    ]
+    findex = FusedDeviceIndex(shards)
+    specs = [
+        QuerySpec("1", 1, 1 << 29, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("1", 500, 2500, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("1", 1, 1 << 29, 1, 1 << 30, alternate_bases="T"),
+    ]
+
+    def enc_for(b: int):
+        batch = [
+            (specs[i % len(specs)], i % n_shards) for i in range(b)
+        ]
+        return encode_queries(
+            [sp for sp, _ in batch], shard_ids=[sid for _, sid in batch]
+        )
+
+    # coalesced burst sizes between the legacy rungs: 9..60 all pad to
+    # tier 64 under BATCH_TIERS; the adaptive ladder catches them at
+    # 16/32/64
+    sizes = [9, 12, 14, 16, 20, 28, 48, 60] * 3
+
+    def ladder_leg(ladder) -> dict:
+        rec = DeviceFlightRecorder(ring_size=512)
+        old = _tel.flight_recorder
+        _tel.flight_recorder = rec
+        set_active_ladder(ladder)
+        try:
+            with device_warmup_phase():
+                for t in active_ladder().rungs:
+                    run_queries(
+                        findex, enc_for(t), window_cap=512, record_cap=64
+                    )
+            for b in sizes:
+                run_queries(
+                    findex, enc_for(b), window_cap=512, record_cap=64
+                )
+            cells = {
+                f"{fam}:{tier}": round(1 - real / padded, 4)
+                for (fam, tier), (real, padded)
+                in rec.pad_tier_histogram().items()
+                if padded
+            }
+            worst_cell, worst = max(
+                cells.items(), key=lambda kv: kv[1]
+            )
+            return {
+                "rungs": list(active_ladder().rungs),
+                "ladder_source": active_ladder().source,
+                "pad_waste_cells": cells,
+                "worst_cell": worst_cell,
+                "worst_pad_waste": worst,
+                "mid_request_compiles": rec.mid_request_compiles(),
+                "compiled_programs": rec.compile_snapshot()["programs"],
+            }
+        finally:
+            set_active_ladder(None)
+            _tel.flight_recorder = old
+
+    legacy = ladder_leg(TierLadder(BATCH_TIERS, source="bench-legacy"))
+    adaptive = ladder_leg(None)  # process default (adaptive rungs)
+    assert legacy["mid_request_compiles"] == 0, legacy
+    assert adaptive["mid_request_compiles"] == 0, adaptive
+    # the tentpole acceptance: the worst padding-waste cell at least
+    # halves under the adaptive ladder on the same traffic
+    assert (
+        adaptive["worst_pad_waste"] <= legacy["worst_pad_waste"] / 2
+    ), (legacy["worst_pad_waste"], adaptive["worst_pad_waste"])
+
+    # -- owner-sharded output diet on the sliced mesh ------------------------
+    from sbeacon_tpu.parallel.mesh import MeshFusedIndex, make_mesh
+
+    mfi = MeshFusedIndex(shards, make_mesh())
+    n_q = 8
+    enc = encode_queries(
+        [specs[i % len(specs)] for i in range(n_q)],
+        shard_ids=[0] * n_q,  # skewed: one device owns every query
+    )
+    rec = DeviceFlightRecorder(ring_size=64)
+    old = _tel.flight_recorder
+    _tel.flight_recorder = rec
+    try:
+        mfi.run_mesh_queries(
+            dict(enc), window_cap=512, record_cap=64, owner_outputs=True
+        )
+        owner_bytes = rec.fetched_bytes
+        mfi.run_mesh_queries(
+            dict(enc), window_cap=512, record_cap=64, owner_outputs=False
+        )
+        repl_bytes = rec.fetched_bytes - owner_bytes
+    finally:
+        _tel.flight_recorder = old
+    assert owner_bytes * 2 <= repl_bytes, (owner_bytes, repl_bytes)
+    return {
+        "legacy": legacy,
+        "adaptive": adaptive,
+        "worst_cell_halved": True,
+        "zero_mid_request_compiles": True,
+        "mesh": {
+            "n_dev": mfi.n_dev,
+            "queries": n_q,
+            "owner_fetched_bytes_per_query": round(owner_bytes / n_q, 1),
+            "replicated_fetched_bytes_per_query": round(
+                repl_bytes / n_q, 1
+            ),
+            "fetched_bytes_ratio": round(owner_bytes / repl_bytes, 4),
+        },
+    }
+
+
+def config21_roofline(c2_detail: dict | None = None):
+    """Roofline campaign (ISSUE 17): the adaptive-ladder vs legacy
+    padding-waste comparison, zero mid-request compiles on both legs,
+    and the owner-sharded fetched-bytes diet on the sliced mesh —
+    inline on a real multi-device mesh, else in a child process with
+    the forced 8-virtual-CPU mesh (config17 pattern). The measured
+    roofline fraction rides in from config2's colocated device-time
+    probe (the same single-chip HBM-bound gather both configs frame
+    their numbers against)."""
+    import jax
+
+    if len(jax.devices()) >= 2:
+        out = _roofline_probe()
+    else:
+        import subprocess
+        import tempfile
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=8"
+        ).strip()
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        with tempfile.NamedTemporaryFile(
+            suffix=".json", delete=False
+        ) as f:
+            out_path = f.name
+        try:
+            code = (
+                "import json, sys, bench; "
+                "json.dump(bench._roofline_probe(), "
+                "open(sys.argv[1], 'w'))"
+            )
+            proc = subprocess.run(
+                [sys.executable, "-c", code, out_path],
+                env=env,
+                cwd=here,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT,
+                text=True,
+                timeout=420,
+            )
+            if proc.returncode != 0:
+                return {
+                    "error": "roofline probe subprocess failed: "
+                    + proc.stdout[-300:]
+                }
+            with open(out_path) as fh:
+                out = json.load(fh)
+        finally:
+            try:
+                os.unlink(out_path)
+            except OSError:
+                pass
+    if c2_detail:
+        out["roofline_fraction"] = c2_detail.get("roofline_fraction")
+        out["gather_gb_per_s"] = c2_detail.get("gather_gb_per_s")
+    return out
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -3699,6 +3917,13 @@ def main() -> None:
     run("config18_device", 40, config18_device)
     run("config19_lsm", 60, config19_lsm)
     run("config20_migrate", 45, config20_migrate)
+    run(
+        "config21_roofline",
+        90,
+        lambda: config21_roofline(
+            detail.get("config2_point_queries") or None
+        ),
+    )
     emit(final=True)
 
 

@@ -128,6 +128,16 @@ class EngineConfig:
     # per-device work — instead of the full replicated batch masked by
     # ownership. Off restores the replicated layout.
     mesh_slice: bool = True
+    # owner-sharded mesh outputs (ISSUE 17, the output diet): under
+    # the sliced layout every query is answered by exactly ONE owning
+    # device, so the launch returns its outputs owner-sharded
+    # (out_specs P('d')) — no psum fan-in, no ring row-gather, and the
+    # fetch pulls each owner's real rows directly instead of one
+    # full-size replicated buffer (~1/n_dev the fetched bytes). Off
+    # restores the replicated-output reassembly. No effect on the
+    # replicated batch layout (mesh_slice off), which genuinely needs
+    # the cross-device combine.
+    mesh_owner_outputs: bool = True
     # stack the genotype planes with their datasets on the mesh tier
     # when every shard has them and the per-device slice fits the
     # plane_hbm_budget_gb headroom: selected-samples / sample-
@@ -596,6 +606,10 @@ class BeaconConfig:
         if "BEACON_MESH_SLICE" in env:
             eng_over["mesh_slice"] = (
                 env["BEACON_MESH_SLICE"].lower() not in _off
+            )
+        if "BEACON_MESH_OWNER_OUTPUTS" in env:
+            eng_over["mesh_owner_outputs"] = (
+                env["BEACON_MESH_OWNER_OUTPUTS"].lower() not in _off
             )
         if "BEACON_MESH_PLANES" in env:
             eng_over["mesh_planes"] = (

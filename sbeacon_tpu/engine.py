@@ -1659,8 +1659,17 @@ class VariantEngine:
         Runs inside a flight-recorder warmup phase (ISSUE 14): the
         compile tracker stamps these (program, shape) keys as EXPECTED,
         so only a shape first compiled outside warmup ticks
-        ``device.mid_request_compiles``."""
+        ``device.mid_request_compiles``.
+
+        The batch-tier ladder is traffic-fit FIRST (ISSUE 17): the
+        recorder's per-(family, tier) padding histogram may split a
+        wasteful rung, and fitting before the warm loops means every
+        fitted rung is pre-compiled in this same phase — the ladder
+        can never grow a rung that serving would compile mid-request."""
+        from .ops.kernel import refit_active_ladder
+
         with device_warmup_phase():
+            refit_active_ladder()
             return self._warmup()
 
     def _warmup(self) -> int:
@@ -1685,12 +1694,13 @@ class VariantEngine:
                         shard.meta.get("dataset_id"),
                     )
             elif dindex is not None:
-                # XLA gather kernel (CPU fallback): compile every fixed
-                # batch-size tier run_queries pads to
-                from .ops.kernel import BATCH_TIERS
+                # XLA gather kernel (CPU fallback): compile every
+                # batch-tier rung run_queries pads to (the process
+                # ladder — the same single source run_queries reads)
+                from .ops.kernel import active_ladder
 
                 try:
-                    for t in BATCH_TIERS:
+                    for t in active_ladder().rungs:
                         run_queries_auto(
                             dindex,
                             [QuerySpec("1", 1, 1, 1, 2)] * t,
@@ -1707,10 +1717,10 @@ class VariantEngine:
         try:
             fst = self._fused_ready(wait=True)
             if fst is not None:
-                from .ops.kernel import BATCH_TIERS
+                from .ops.kernel import active_ladder
 
                 findex = fst[0]
-                for t in BATCH_TIERS:
+                for t in active_ladder().rungs:
                     run_queries_auto(
                         findex,
                         encode_queries(

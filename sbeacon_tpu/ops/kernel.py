@@ -26,7 +26,11 @@ Design notes (TPU/XLA):
 from __future__ import annotations
 
 import math
+import os
+import threading
 import time
+import warnings
+from contextlib import contextmanager
 from dataclasses import dataclass
 from functools import partial
 
@@ -205,6 +209,26 @@ def padded_rows(n: int, pad_unit: int) -> int:
     return max(pad_unit, ((n + pad_unit - 1) // pad_unit) * pad_unit)
 
 
+def window_hint_for(chrom_offsets, floor: int = 256) -> int:
+    """Power-of-two window bound from a chromosome segment table.
+
+    A query's candidate range is always contained in ONE (shard,
+    chromosome) segment — the bisection never leaves ``[seg_lo,
+    seg_hi)`` — so the widest segment bounds every ``hi - lo`` the
+    kernel can produce. Launching with this instead of the engine-wide
+    ``window_cap`` shrinks the per-lane gather (the launch's compute)
+    without ever adding an overflow. Power-of-two with a floor, so the
+    hint (a static program dimension) is stable across rebuilds."""
+    offs = np.asarray(chrom_offsets)
+    widest = (
+        int(np.diff(offs, axis=-1).max(initial=0)) if offs.size else 0
+    )
+    hint = floor
+    while hint < widest:
+        hint *= 2
+    return hint
+
+
 def bisect_iters(n_pad: int) -> int:
     """Fixed bisection depth covering a padded row count."""
     return max(1, math.ceil(math.log2(n_pad + 1)))
@@ -231,6 +255,9 @@ class DeviceIndex:
             for k, v in pad_shard_columns(shard, n_pad).items()
         }
         self.n_iters = bisect_iters(n_pad)
+        #: measured widest-hit-range bound (see window_hint_for):
+        #: run_queries clamps its window_cap to this
+        self.window_hint = window_hint_for(shard.chrom_offsets)
 
 
 class FusedDeviceIndex:
@@ -283,6 +310,12 @@ class FusedDeviceIndex:
         #: table, so its program identity uses the padded count)
         self.n_shards_padded = len(shards)
         self.shard_base = base  # int64[k+1]
+        #: ragged-window bound generalised from the L0 mini-index
+        #: (ISSUE 17): the widest (shard, chromosome) segment of the
+        #: stack bounds every candidate range, so record-heavy
+        #: launches stop paying the engine-wide window_cap gather
+        #: width (the L0 subclass overrides with its tail-shard bound)
+        self.window_hint = window_hint_for(chrom_offsets)
 
     def to_local_rows(self, rows: np.ndarray, sid: int) -> np.ndarray:
         """Stacked row ids (already -1-filtered) -> shard-local ids."""
@@ -502,8 +535,7 @@ def _query_one(arrays, q, *, window_cap: int, record_cap: int, n_iters: int):
     }
 
 
-@partial(jax.jit, static_argnames=("window_cap", "record_cap", "n_iters"))
-def _query_batch(arrays, enc, *, window_cap, record_cap, n_iters):
+def _query_batch_impl(arrays, enc, *, window_cap, record_cap, n_iters):
     fn = partial(
         _query_one,
         arrays,
@@ -514,10 +546,229 @@ def _query_batch(arrays, enc, *, window_cap, record_cap, n_iters):
     return jax.vmap(fn)(enc)
 
 
-# fixed batch-size tiers for compiled-program reuse (<=8x padding
-# overhead, 4 programs total); batches beyond the top tier run at
-# their exact size (bulk benchmark shapes, not serving)
+_JIT_STATICS = ("window_cap", "record_cap", "n_iters")
+
+#: the jitted query-batch entry (tools/check_launch_recording.py pins
+#: run_queries as its one caller)
+_query_batch = partial(jax.jit, static_argnames=_JIT_STATICS)(
+    _query_batch_impl
+)
+
+#: same program, but the encoded query-batch buffers (positional arg 1)
+#: are DONATED: steady-state serving uploads a fresh encode dict per
+#: launch, and without donation XLA double-buffers every one of them in
+#: HBM next to its output. The index arrays (arg 0) are persistent and
+#: never donated. Leaves whose shape/dtype match no output are simply
+#: freed rather than aliased — that is still the win — so the advisory
+#: "donated buffers were not usable" warning is noise here.
+_query_batch_donated = partial(
+    jax.jit, static_argnames=_JIT_STATICS, donate_argnums=(1,)
+)(_query_batch_impl)
+
+
+@contextmanager
+def _quiet_donation():
+    """Silence the advisory unusable-donation warning around a donated
+    launch — a module-level filter would be undone by test harnesses
+    that reset warning state per test."""
+    with warnings.catch_warnings():
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable"
+        )
+        yield
+
+
+def _donate_uploads() -> bool:
+    """Process default for encode-buffer donation on the upload path
+    (``BEACON_DONATE_UPLOADS``; on unless explicitly disabled)."""
+    return os.environ.get(
+        "BEACON_DONATE_UPLOADS", "1"
+    ).lower() not in ("0", "false", "off", "no")
+
+
+# the LEGACY fixed batch-size tiers (<=8x padding overhead, 4 programs
+# total); batches beyond the top tier run at their exact size (bulk
+# benchmark shapes, not serving). Kept as the documented baseline and
+# the BEACON_TIER_LADDER=legacy escape hatch — live tier selection
+# consults the process TierLadder below (ISSUE 17).
 BATCH_TIERS = (8, 64, 512, 2048)
+
+
+class TierLadder:
+    """The batch-size tier ladder every padding seam consults.
+
+    PR 14's flight recorder showed the coarse ``BATCH_TIERS`` ladder
+    wasting up to 7 of 8 padded lanes at tier boundaries (worst
+    (family, tier) cells ~0.86), and PR 15's private finer ladder on
+    the L0 mini-index proved finer rungs pay for themselves: the extra
+    compiled programs are warmed off the request path and the padding
+    waste collapses. This class promotes that ladder to a single
+    process-wide source of truth — ``run_queries`` batch padding, the
+    mesh tier's replicated batch padding and per-device slice tiers,
+    and the engine/dispatch warmup loops all read the SAME instance,
+    so a rung can never exist for serving without being pre-compiled
+    (``tools/check_launch_recording.py`` lints the parity).
+
+    Rungs are fit to measured traffic: :meth:`fit` reads the
+    recorder's per-(family, tier) real-vs-padded histogram and splits
+    any rung whose waste exceeds ``WASTE_SPLIT`` — or the operator
+    pins the ladder with ``BEACON_TIER_LADDER`` (comma-separated rungs,
+    or ``legacy`` for the old 4-tier ladder)."""
+
+    #: the L0-proven default (PR 15): fills the 8->64 gap where the
+    #: recorder saw the worst serving-tier waste
+    DEFAULT_RUNGS = (8, 16, 32, 64, 512, 2048)
+    #: per-device slice rungs at or under this are pre-compiled by the
+    #: mesh tier's warmup; larger rungs are bulk shapes that compile at
+    #: first use like the legacy ladder's top tiers
+    MESH_WARM_CAP = 64
+    #: a (family, tier) histogram cell wasting more than this fraction
+    #: of its padded lanes earns a finer rung below it
+    WASTE_SPLIT = 0.5
+    #: fit() never grows the ladder beyond this many rungs (each rung
+    #: is a compiled program per family — warmup time and program
+    #: cache both scale with it)
+    MAX_RUNGS = 12
+    #: families whose recorded padding carries the n_dev slice
+    #: replication factor (``specs_padded = c_slot * n_dev``) — their
+    #: waste measures batch SKEW across owning devices, which a finer
+    #: batch rung cannot fix (the slice ladder already floors at 1), so
+    #: fit() must not chase it; left unchecked it splits every warmup's
+    #: own skewed mesh launches into ever-smaller rungs
+    FIT_SKIP_FAMILIES = frozenset({"mesh_sliced", "plane"})
+
+    __slots__ = ("rungs", "source")
+
+    def __init__(self, rungs, source: str = "default"):
+        clean = tuple(sorted({int(r) for r in rungs if int(r) > 0}))
+        if not clean:
+            raise ValueError("TierLadder needs at least one rung")
+        self.rungs = clean
+        self.source = source
+
+    def tier_for(self, b: int):
+        """Smallest rung holding a batch of ``b``; None past the top
+        rung (bulk batches run at their exact size)."""
+        return next((t for t in self.rungs if b <= t), None)
+
+    @property
+    def slice_rungs(self) -> tuple:
+        """Per-device slice shape tiers: the ladder plus a 1-floor —
+        the whole point of slicing is that each device sees
+        ~batch/n_dev queries, so padding every slice back up to the
+        8-floor would erase the win for the common pod fan-out."""
+        return self.rungs if self.rungs[0] == 1 else (1,) + self.rungs
+
+    def mesh_warm_rungs(self) -> tuple:
+        """The slice rungs MeshDispatchTier pre-compiles (all rungs <=
+        MESH_WARM_CAP; larger slices are bulk shapes outside the
+        serving path, same exposure as the legacy ladder)."""
+        return tuple(
+            t for t in self.slice_rungs if t <= self.MESH_WARM_CAP
+        )
+
+    @classmethod
+    def from_env(cls, env=None) -> "TierLadder":
+        """The env-pinned ladder (``BEACON_TIER_LADDER``: comma rungs
+        or ``legacy``), else the default. Malformed values fall back
+        to the default — a bad knob must not take serving down."""
+        raw = (env if env is not None else os.environ).get(
+            "BEACON_TIER_LADDER", ""
+        ).strip()
+        if not raw:
+            return cls(cls.DEFAULT_RUNGS, source="default")
+        if raw.lower() == "legacy":
+            return cls(BATCH_TIERS, source="env")
+        try:
+            return cls(
+                [int(p) for p in raw.split(",") if p.strip()],
+                source="env",
+            )
+        except ValueError:
+            return cls(cls.DEFAULT_RUNGS, source="default")
+
+    def fit(self, pad_tier_hist: dict) -> "TierLadder":
+        """A traffic-fit refinement of this ladder: any (family, tier)
+        cell of the recorder's real-vs-padded histogram wasting more
+        than ``WASTE_SPLIT`` of its padded lanes earns the half-rung
+        below its tier (repeatedly halving would chase noise; one
+        split per observed-bad rung per fit keeps the ladder bounded
+        and the warmup cheap). Slice-replicated families
+        (``FIT_SKIP_FAMILIES``) and splits below the ladder floor are
+        ignored, so successive fits converge — warming the fitted
+        ladder never creates cells that would re-split it. Rung count
+        is capped at MAX_RUNGS, keeping the worst offenders."""
+        splits = []
+        for (family, tier), (real, padded) in pad_tier_hist.items():
+            tier = int(tier)
+            if family in self.FIT_SKIP_FAMILIES:
+                continue
+            half = tier // 2
+            # never split below the ladder floor: waste at the bottom
+            # rung is the floor's known cost, not a mis-fit ladder, and
+            # sub-floor rungs would leak into every consumer of
+            # active_ladder() (a 3-query batch must keep padding to 8)
+            if not padded or tier not in self.rungs or half < self.rungs[0]:
+                continue
+            waste = 1.0 - real / padded
+            if waste > self.WASTE_SPLIT and half not in self.rungs:
+                splits.append((waste, half))
+        if not splits:
+            return self
+        splits.sort(reverse=True)
+        budget = max(0, self.MAX_RUNGS - len(self.rungs))
+        extra = []
+        for _waste, rung in splits:
+            if rung in extra:
+                continue
+            if len(extra) >= budget:
+                break
+            extra.append(rung)
+        if not extra:
+            return self
+        return TierLadder(self.rungs + tuple(extra), source="fit")
+
+
+_LADDER_LOCK = threading.Lock()
+_ACTIVE_LADDER: TierLadder | None = None
+
+
+def active_ladder() -> TierLadder:
+    """The process tier ladder — THE single source every padding seam
+    (run_queries, the mesh batch/slice tiers, dispatch fan-out padding,
+    and all warmup loops) consults."""
+    global _ACTIVE_LADDER
+    with _LADDER_LOCK:
+        if _ACTIVE_LADDER is None:
+            _ACTIVE_LADDER = TierLadder.from_env()
+        return _ACTIVE_LADDER
+
+
+def set_active_ladder(ladder: TierLadder | None) -> None:
+    """Install (or with None, reset to env/default) the process
+    ladder. Callers own re-warming: a rung that reaches serving
+    without a warmup compile is exactly what the warmup-ladder lint
+    exists to catch."""
+    global _ACTIVE_LADDER
+    with _LADDER_LOCK:
+        _ACTIVE_LADDER = ladder
+
+
+def refit_active_ladder(recorder=None) -> TierLadder:
+    """Traffic-fit the process ladder from the flight recorder's
+    per-(family, tier) histogram — the engine calls this at the top of
+    ``warmup()``, so every fitted rung is pre-compiled in the same
+    warmup phase. An env-pinned ladder (``BEACON_TIER_LADDER``) is the
+    operator's word and never refit."""
+    global _ACTIVE_LADDER
+    if recorder is None:
+        from ..telemetry import flight_recorder as recorder
+    with _LADDER_LOCK:
+        ladder = _ACTIVE_LADDER or TierLadder.from_env()
+        if ladder.source != "env":
+            ladder = ladder.fit(recorder.pad_tier_histogram())
+        _ACTIVE_LADDER = ladder
+        return ladder
 
 
 class PendingQueryResults:
@@ -544,7 +795,11 @@ class PendingQueryResults:
         t0 = time.perf_counter()
         out = jax.device_get(self._out)
         note_device_stage(
-            self.flight_seq, fetch_ms=(time.perf_counter() - t0) * 1e3
+            self.flight_seq,
+            fetch_ms=(time.perf_counter() - t0) * 1e3,
+            fetch_bytes=sum(
+                np.asarray(v).nbytes for v in out.values()
+            ),
         )
         self._out = None  # free the device buffers promptly
         b = self._b
@@ -605,10 +860,19 @@ def run_queries(
         encode_queries(queries) if isinstance(queries, list) else queries
     )
     b = int(enc["chrom"].shape[0])
+    # ragged-window clamp: the index's measured widest-hit-range bound
+    # (never adds an overflow — see window_hint_for). Applied HERE, the
+    # one choke point, so warmup and serving can't compile different
+    # window shapes for the same index.
+    window_cap = min(
+        window_cap, getattr(dindex, "window_hint", window_cap)
+    )
     # an index may carry its own (finer) tier ladder — the L0
     # mini-index does, so a per-tail-shard spec batch is not padded to
-    # the global 64 tier
-    tiers = getattr(dindex, "batch_tiers", BATCH_TIERS)
+    # the global 64 tier; everything else pads to the process ladder
+    tiers = getattr(dindex, "batch_tiers", None)
+    if tiers is None:
+        tiers = active_ladder().rungs
     tier = next((t for t in tiers if b <= t), None)
     if b and tier and tier != b:
         enc = {
@@ -618,16 +882,19 @@ def run_queries(
             for k, v in enc.items()
         }
     padded = tier if (b and tier) else b
+    donate = _donate_uploads()
     with span("kernel.run_queries") as sp:
         t0 = time.perf_counter()
         enc_dev = {k: jnp.asarray(v) for k, v in enc.items()}
-        out = _query_batch(
-            dindex.arrays,
-            enc_dev,
-            window_cap=window_cap,
-            record_cap=record_cap,
-            n_iters=dindex.n_iters,
-        )
+        batch_fn = _query_batch_donated if donate else _query_batch
+        with _quiet_donation():
+            out = batch_fn(
+                dindex.arrays,
+                enc_dev,
+                window_cap=window_cap,
+                record_cap=record_cap,
+                n_iters=dindex.n_iters,
+            )
         launch_ms = (time.perf_counter() - t0) * 1e3
         # ONE flight-recorder seam per launch: counters, the launch
         # ring, and compile tracking (a first-seen (program, shape)
@@ -643,8 +910,12 @@ def run_queries(
             specs_real=b,
             specs_padded=padded,
             launch_ms=launch_ms,
+            donated=len(enc_dev) if donate else 0,
             program_key=(
                 "xla_gather",
+                # the donated entry is a distinct compiled program
+                # (separate jit cache), so donation is program identity
+                "don" if donate else "nodon",
                 type(dindex).__name__,
                 dindex.n_padded,
                 # a fused stack rebuild can keep n_padded while its

@@ -729,7 +729,11 @@ def run_selected_scattered(
                 t0 = time.perf_counter()
                 a, r, pc, pt, ow = jax.device_get((a, r, pc, pt, ow))
                 note_device_stage(
-                    seq, fetch_ms=(time.perf_counter() - t0) * 1e3
+                    seq,
+                    fetch_ms=(time.perf_counter() - t0) * 1e3,
+                    fetch_bytes=sum(
+                        np.asarray(v).nbytes for v in (a, r, pc, pt, ow)
+                    ),
                 )
                 agg[ss] = np.asarray(a)[:bb]
                 rows[ss, :R] = np.asarray(r)[:bb]
@@ -1025,8 +1029,13 @@ def run_queries_scattered(
         # its wall time is each launch's fetch stage (they complete as
         # a unit), so every record in the batch carries it
         fetch_ms = (time.perf_counter() - t_fetch) * 1e3
-        for _sel, _ad, _md, seq in launched:
-            note_device_stage(seq, fetch_ms=fetch_ms)
+        for (_sel, _ad, _md, seq), (a, masks) in zip(launched, fetched):
+            note_device_stage(
+                seq,
+                fetch_ms=fetch_ms,
+                fetch_bytes=np.asarray(a).nbytes
+                + (np.asarray(masks).nbytes if masks is not None else 0),
+            )
         for (sel, _ad, _md, _q), (a, masks) in zip(launched, fetched):
             agg[sel] = np.asarray(a)[: len(sel)]
             if with_rows:

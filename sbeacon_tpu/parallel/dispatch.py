@@ -1339,9 +1339,13 @@ class MeshDispatchTier:
     the ``mesh.fallbacks`` counter.
     """
 
-    #: batch tiers pre-compiled by :meth:`warmup` (the serving batcher
-    #: pads k-spec submissions to kernel.BATCH_TIERS; a k<=8 fan-out —
-    #: the common pod query — must never pay a mid-request compile)
+    #: LEGACY warm tiers, kept for back-compat introspection only:
+    #: :meth:`warmup` now pre-compiles every serving rung of the
+    #: process TierLadder (``kernel.active_ladder().mesh_warm_rungs``
+    #: — ISSUE 17), so the warm set and the slice-tier padding read
+    #: the same single source and a ladder edit cannot silently
+    #: reintroduce mid-request compiles (the warmup-ladder lint in
+    #: tools/check_launch_recording.py asserts the parity)
     WARM_TIERS = (8, 64)
 
     def __init__(
@@ -1539,6 +1543,9 @@ class MeshDispatchTier:
                 axis=self.axis,
                 with_planes=with_planes,
                 slice_batch=getattr(eng_cfg, "mesh_slice", None),
+                owner_outputs=getattr(
+                    eng_cfg, "mesh_owner_outputs", None
+                ),
             )
             sid_of = {k: i for i, k in enumerate(keys)}
             shard_of = dict(zip(keys, shards))
@@ -1629,7 +1636,7 @@ class MeshDispatchTier:
         state = self._ready(wait=True)
         if state is None:
             return 0
-        from ..ops.kernel import QuerySpec, encode_queries
+        from ..ops.kernel import QuerySpec, active_ladder, encode_queries
 
         index = state[0]
         eng = self.engine.config.engine
@@ -1637,14 +1644,19 @@ class MeshDispatchTier:
         spec = QuerySpec("1", 1, 1, 1, 2)
         # the sliced layout keys programs on the PER-DEVICE slice tier:
         # a single-hot-shard batch of t slices to C=t, while the common
-        # pod fan-out (<= one query per device) slices to C=1 — warm
-        # both shapes so neither pays a mid-request shard_map compile
+        # pod fan-out (<= one query per device) slices to C=1 (the
+        # spread batch) — warm EVERY serving rung of the process
+        # ladder so no coalesced burst pays a mid-request shard_map
+        # compile (rungs past MESH_WARM_CAP are bulk shapes outside
+        # the serving path, same exposure as the legacy ladder)
         spread = [
             g * index.d_local
             for g in range(index.n_dev)
             if g * index.d_local < index.n_shards
         ]
-        batches = [[0] * t for t in self.WARM_TIERS] + [spread]
+        batches = [
+            [0] * t for t in active_ladder().mesh_warm_rungs() if t > 1
+        ] + [spread]
         for sids in batches:
             index.run_mesh_queries(
                 encode_queries([spec] * len(sids), shard_ids=sids),

@@ -35,15 +35,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..index.columnar import N_CHROM_CODES, VariantIndexShard
 from ..ops.kernel import (
-    BATCH_TIERS,
     DeviceIndex,
     QueryResults,
+    _donate_uploads,
     _query_one,
+    _quiet_donation,
+    active_ladder,
     bisect_iters,
     encode_queries,
     pad_columns,
     pad_shard_columns,
     padded_rows,
+    window_hint_for,
 )
 
 AXIS = "d"
@@ -90,13 +93,25 @@ def _slice_default() -> bool:
     return os.environ.get("BEACON_MESH_SLICE", "1").lower() not in ENV_OFF
 
 
-#: per-device slice shape tiers: finer than kernel.BATCH_TIERS at the
-#: small end — the whole point of slicing is that each device sees
-#: ~batch/n_dev queries, so padding every slice back up to the 8-floor
-#: would erase the win for the common pod fan-out (a k<=n_dev-target
-#: query slices to ONE query per device). Still a bounded set, so the
-#: compiled-program cache stays a handful of shapes per config.
+#: LEGACY per-device slice shape tiers, kept as the documented
+#: baseline: live slice-tier selection consults
+#: ``kernel.active_ladder().slice_rungs`` (the process TierLadder with
+#: a 1-floor — ISSUE 17), so batch padding and slice padding can never
+#: drift onto different ladders. Still a bounded set either way, so
+#: the compiled-program cache stays a handful of shapes per config.
 SLICE_TIERS = (1, 8, 64, 512, 2048)
+
+
+def _owner_default() -> bool:
+    """Process default for owner-sharded mesh outputs
+    (BEACON_MESH_OWNER_OUTPUTS; on unless explicitly disabled).
+    MeshFusedIndex instances built by the dispatch tier carry the
+    config-resolved value instead."""
+    from ..config import ENV_OFF
+
+    return os.environ.get(
+        "BEACON_MESH_OWNER_OUTPUTS", "1"
+    ).lower() not in ENV_OFF
 
 
 def shard_map_compat(body, *, mesh, in_specs, out_specs, check_rep=True):
@@ -674,31 +689,110 @@ class MeshPendingResults:
     :meth:`fetch` applies the inverse permute so callers see their
     original order; None means the replicated layout (trim to the
     first ``b`` rows). Plane outputs (``pc_call``/``pc_tok``/
-    ``or_words``) ride along when the launch ran the plane program."""
+    ``or_words``) ride along when the launch ran the plane program.
 
-    __slots__ = ("_out", "_b", "_pos", "flight_seq")
+    ``owner_layout`` non-None means the launch returned OWNER-SHARDED
+    outputs (``out_specs P(axis)`` — the output diet, ISSUE 17):
+    device g holds slots ``[g*c_slot, (g+1)*c_slot)`` and only the
+    first ``counts[g]`` carry real queries. :meth:`fetch` then pulls
+    each owner's real rows directly off its shard — the bytes crossing
+    device->host are ~the real batch, not ``n_dev*c_slot`` padded
+    slots — and asserts it never materialises a full-size replica."""
+
+    __slots__ = ("_out", "_b", "_pos", "_owner", "flight_seq")
 
     def __init__(self, out, b: int, positions=None,
-                 flight_seq: int | None = None):
+                 flight_seq: int | None = None, owner_layout=None):
         self._out = out
         self._b = b
         self._pos = positions
+        #: (n_dev, c_slot, counts[n_dev]) under owner-sharded outputs
+        self._owner = owner_layout
         #: the launch's flight-recorder record (fetch-stage timing)
         self.flight_seq = flight_seq
+
+    @staticmethod
+    def _fetch_device(a):
+        """The explicit fetch device for a replicated output leaf: the
+        lowest-id addressable device. ``jax.device_get`` on a fully
+        replicated array reads shard 0 *by convention*; making the
+        choice explicit here keeps the fetch path auditable (and
+        stable if the runtime's shard ordering ever changes)."""
+        shards = getattr(a, "addressable_shards", None)
+        if not shards:
+            return None
+        return min(
+            shards, key=lambda s: getattr(s.device, "id", 0)
+        ).data
+
+    def _host_replicated(self) -> dict:
+        """One replica per leaf, from the explicit fetch device."""
+        picked = {}
+        for k, a in self._out.items():
+            data = self._fetch_device(a)
+            picked[k] = a if data is None else data
+        return jax.device_get(picked)
+
+    def _host_owner_sharded(self):
+        """Each owner's real rows, straight off its shard.
+
+        Returns ``(host, sel_idx)``: host leaves are the counts-trimmed
+        owner blocks concatenated in owner order (``sum(counts)``
+        rows), and ``sel_idx[j]`` is query j's row in that compact
+        layout."""
+        n_dev, c_slot, counts = self._owner
+        host = {}
+        for k, a in self._out.items():
+            shards = getattr(a, "addressable_shards", None)
+            # single-controller contract (ROADMAP item 1): every
+            # output shard is addressable from this process
+            assert shards is not None and len(shards) == n_dev, (
+                "owner-sharded fetch needs all output shards "
+                "addressable (single-controller pod)"
+            )
+            blocks = sorted(
+                shards, key=lambda s: s.index[0].start or 0
+            )
+            parts = []
+            for g, sh in enumerate(blocks):
+                # the output diet's invariant: each device holds ONLY
+                # its own c_slot-slot block — a full-size (replicated)
+                # shard here would mean the program regressed to
+                # reassembling every device's output
+                assert sh.data.shape[0] == c_slot, (
+                    f"owner-sharded output leaf {k!r} materialised a "
+                    f"{sh.data.shape[0]}-slot shard (want {c_slot})"
+                )
+                parts.append(sh.data[: int(counts[g])])
+            host[k] = parts
+        host = jax.device_get(host)
+        host = {k: np.concatenate(v) for k, v in host.items()}
+        starts = np.concatenate(([0], np.cumsum(counts)))[:-1]
+        pos = np.asarray(self._pos)
+        sel_idx = starts[pos // c_slot] + pos % c_slot
+        return host, sel_idx
 
     def fetch(self) -> QueryResults:
         from ..telemetry import note_device_stage
 
         t0 = time.perf_counter()
-        out = jax.device_get(self._out)
+        if self._owner is not None:
+            out, sel_idx = self._host_owner_sharded()
+            sel = lambda a: np.asarray(a)[sel_idx]
+        else:
+            out = self._host_replicated()
+            if self._pos is None:
+                sel = lambda a: np.asarray(a)[: self._b]
+            else:
+                sel = lambda a: np.asarray(a)[self._pos]
         note_device_stage(
-            self.flight_seq, fetch_ms=(time.perf_counter() - t0) * 1e3
+            self.flight_seq,
+            fetch_ms=(time.perf_counter() - t0) * 1e3,
+            fetch_bytes=sum(
+                np.asarray(v).nbytes for v in out.values()
+            ),
         )
         self._out = None  # free the device buffers promptly
-        if self._pos is None:
-            sel = lambda a: np.asarray(a)[: self._b]
-        else:
-            sel = lambda a: np.asarray(a)[self._pos]
         extra = {
             k: sel(out[k])
             for k in ("pc_call", "pc_tok", "or_words")
@@ -775,6 +869,7 @@ class MeshFusedIndex:
         pad_unit: int | None = None,
         with_planes: bool = False,
         slice_batch: bool | None = None,
+        owner_outputs: bool | None = None,
     ):
         from ..index.columnar import stack_shard_columns
 
@@ -785,6 +880,9 @@ class MeshFusedIndex:
         #: per-device batch slicing default for run_mesh_queries
         #: (None = the BEACON_MESH_SLICE process default at call time)
         self.slice_batch = slice_batch
+        #: owner-sharded output default for run_mesh_queries (None =
+        #: the BEACON_MESH_OWNER_OUTPUTS process default at call time)
+        self.owner_outputs = owner_outputs
         n_dev = int(mesh.devices.size)
         d = len(shards)
         d_local = -(-d // n_dev)  # shards per device, last groups may pad
@@ -890,6 +988,12 @@ class MeshFusedIndex:
         self.seg_base = jax.device_put(jnp.asarray(seg_base), sharding)
         self.n_padded = n_pad
         self.n_iters = bisect_iters(n_pad)
+        #: ragged-window bound (ISSUE 17): the widest (shard,
+        #: chromosome) segment across every device's block —
+        #: run_mesh_queries clamps its window_cap to this, so
+        #: record-heavy launches stop paying the engine-wide gather
+        #: width (never adds an overflow; see kernel.window_hint_for)
+        self.window_hint = window_hint_for(offsets)
 
     @classmethod
     def plane_bytes_per_device(
@@ -930,20 +1034,24 @@ class MeshFusedIndex:
         """Owner-sorted sliced layout: permute the encoded batch so
         device g's queries occupy slots ``[g*C, g*C+count_g)`` of a
         ``[n_dev*C]`` array (C = the largest per-device count padded to
-        a shared ``SLICE_TIERS`` tier, so the compiled-program cache
-        stays a handful of per-device shapes). Padding slots carry an
-        inert filler (chrom code 0 — its row span is empty in every
-        shard — targeted at the slot's own device group, so the filler
-        never crosses an ownership boundary); their output positions
-        are simply never read back. Returns the padded
-        ``(enc, masks, use_counts, positions)`` where ``positions[j]``
-        is query j's slot — the inverse permute applied at fetch."""
+        a shared tier of the process ladder's ``slice_rungs``, so the
+        compiled-program cache stays a handful of per-device shapes).
+        Padding slots carry an inert filler (chrom code 0 — its row
+        span is empty in every shard — targeted at the slot's own
+        device group, so the filler never crosses an ownership
+        boundary); their output positions are simply never read back.
+        Returns the padded
+        ``(enc, masks, use_counts, positions, counts, c_slot)`` where
+        ``positions[j]`` is query j's slot — the inverse permute
+        applied at fetch — and ``counts[g]`` is device g's real query
+        count (the owner-sharded fetch's trim bound)."""
         shard = np.asarray(enc["shard"])
         b = shard.shape[0]
         owner = shard // self.d_local
         counts = np.bincount(owner, minlength=self.n_dev)
         cmax = int(counts.max())
-        c_slot = next((t for t in SLICE_TIERS if cmax <= t), cmax)
+        slice_rungs = active_ladder().slice_rungs
+        c_slot = next((t for t in slice_rungs if cmax <= t), cmax)
         order = np.argsort(owner, kind="stable")
         starts = np.concatenate(([0], np.cumsum(counts)))[:-1]
         ranks = np.arange(b, dtype=np.int64) - np.repeat(starts, counts)
@@ -971,7 +1079,7 @@ class MeshFusedIndex:
             uc = np.zeros(total, np.bool_)
             uc[pos] = use_counts
             use_counts = uc
-        return out, masks, use_counts, pos
+        return out, masks, use_counts, pos, counts, c_slot
 
     def run_mesh_queries(
         self,
@@ -983,6 +1091,7 @@ class MeshFusedIndex:
         sample_masks=None,
         mask_counts=None,
         slice_batch: bool | None = None,
+        owner_outputs: bool | None = None,
     ):
         """ONE compiled launch answering a (shard, query)-pair batch.
 
@@ -1011,7 +1120,19 @@ class MeshFusedIndex:
         targeting its shards (~1/n_dev the per-device work) instead of
         the full replicated batch masked by ownership. The psum fan-in
         and ring row-gather reassemble, and the inverse permute
-        restores caller order at fetch."""
+        restores caller order at fetch.
+
+        ``owner_outputs`` (default: the index's config, else
+        ``BEACON_MESH_OWNER_OUTPUTS``; sliced layout only) keeps the
+        outputs OWNER-SHARDED (``out_specs P(axis)``): the sliced
+        layout routes every query — and every inert filler — to
+        exactly one owning device, so no output needs a cross-device
+        combine at all. The program skips the psum fan-in AND the ring
+        row-gather (the ``gather_partials_many`` combine remains only
+        for the replicated layout and the StackedIndex paths, which
+        genuinely reduce across devices), and :meth:`fetch` pulls each
+        owner's real rows directly instead of one full-size replica —
+        the fetched bytes and the ring pass both shrink ~1/n_dev."""
         if isinstance(queries, list):
             raise ValueError(
                 "MeshFusedIndex batches must carry explicit shard ids "
@@ -1032,6 +1153,10 @@ class MeshFusedIndex:
                 "genotype planes (built with_planes=False)"
             )
         b = int(enc["chrom"].shape[0])
+        # ragged-window clamp at the one choke point (warmup and
+        # serving both route through here, so the compiled window
+        # shape can never differ between them)
+        window_cap = min(window_cap, self.window_hint)
         use_slice = (
             slice_batch
             if slice_batch is not None
@@ -1042,6 +1167,18 @@ class MeshFusedIndex:
             )
         )
         use_slice = bool(use_slice) and self.n_dev > 1 and b > 0
+        owner_out = (
+            owner_outputs
+            if owner_outputs is not None
+            else (
+                self.owner_outputs
+                if self.owner_outputs is not None
+                else _owner_default()
+            )
+        )
+        # owner-sharded outputs require the sliced layout: only there
+        # is every query (and filler) single-owner by construction
+        owner_out = bool(owner_out) and use_slice
         masks = None
         use_counts = None
         if with_planes:
@@ -1058,13 +1195,16 @@ class MeshFusedIndex:
                 # must come from the host path, never a zero plane
                 use_counts = np.zeros(b, np.bool_)
         pos = None
+        owner_layout = None
         if use_slice:
-            enc, masks, use_counts, pos = self._slice_layout(
-                enc, masks, use_counts
+            enc, masks, use_counts, pos, counts, c_slot = (
+                self._slice_layout(enc, masks, use_counts)
             )
             local_b = int(enc["chrom"].shape[0]) // self.n_dev
+            if owner_out:
+                owner_layout = (self.n_dev, c_slot, counts)
         else:
-            tier = next((t for t in BATCH_TIERS if b <= t), None)
+            tier = active_ladder().tier_for(b)
             if b and tier and tier != b:
                 enc = {
                     k: np.concatenate(
@@ -1083,6 +1223,7 @@ class MeshFusedIndex:
         gather_impl = (
             "pallas" if jax.default_backend() == "tpu" else "portable"
         )
+        donate = _donate_uploads()
         key = (
             "mesh_fused",
             self.mesh,
@@ -1096,6 +1237,8 @@ class MeshFusedIndex:
             use_slice,
             with_planes,
             self.has_count_planes if with_planes else False,
+            owner_out,
+            donate,
         )
         fn = _FN_CACHE.get(key)
         if fn is None:
@@ -1109,6 +1252,7 @@ class MeshFusedIndex:
                 gather_impl=gather_impl,
                 sliced=use_slice,
                 has_counts=self.has_count_planes,
+                owner_out=owner_out,
             )
             if with_planes:
                 body = lambda a, sb, e, m, uc: _local_fused_query(
@@ -1119,24 +1263,35 @@ class MeshFusedIndex:
                     if use_slice
                     else (P(), P())
                 )
+                donate_nums = (2, 3, 4)
             else:
                 body = lambda a, sb, e: _local_fused_query(
                     a, sb, e, None, None, **kw
                 )
                 extra_specs = ()
+                donate_nums = (2,)
             enc_spec = P(self.axis) if use_slice else P()
-            fn = jax.jit(
-                shard_map_compat(
-                    body,
-                    mesh=self.mesh,
-                    in_specs=(P(self.axis), P(self.axis), enc_spec)
-                    + extra_specs,
-                    out_specs=P(),
-                    # axis_index-driven ownership masking defeats the
-                    # replication checker; the outputs ARE replicated
-                    # (psum / full ring gather)
-                    check_rep=False,
-                )
+            mapped = shard_map_compat(
+                body,
+                mesh=self.mesh,
+                in_specs=(P(self.axis), P(self.axis), enc_spec)
+                + extra_specs,
+                # owner-sharded outputs stay on their owning device
+                # (the output diet); otherwise the outputs ARE
+                # replicated (psum / full ring gather)
+                out_specs=P(self.axis) if owner_out else P(),
+                # axis_index-driven ownership masking defeats the
+                # replication checker either way
+                check_rep=False,
+            )
+            # donate the per-launch upload buffers (encode dict +
+            # plane masks; the persistent index arrays at args 0-1 are
+            # never donated) — steady-state serving stops
+            # double-buffering every encode batch in HBM
+            fn = (
+                jax.jit(mapped, donate_argnums=donate_nums)
+                if donate
+                else jax.jit(mapped)
             )
             _FN_CACHE[key] = fn
         from ..telemetry import record_device_launch
@@ -1158,7 +1313,7 @@ class MeshFusedIndex:
             args = (self.arrays, self.seg_base, enc_dev)
             if with_planes:
                 args = args + (put(masks), put(use_counts))
-            with _collective_guard():
+            with _collective_guard(), _quiet_donation():
                 out = fn(*args)
                 if jax.default_backend() == "cpu":
                     # the guard must cover the EXECUTION, not just the
@@ -1183,6 +1338,9 @@ class MeshFusedIndex:
                 evaluated_pairs=local_b * self.n_dev,
                 launch_ms=launch_ms,
                 sliced=use_slice,
+                donated=(len(enc_dev) + (2 if with_planes else 0))
+                if donate
+                else 0,
                 program_key=(
                     "mesh",
                     self.n_dev,
@@ -1197,6 +1355,10 @@ class MeshFusedIndex:
                     local_b,
                     window_cap,
                     record_cap,
+                    # owner-sharded and donated variants are distinct
+                    # compiled programs (out_specs / donate_argnums)
+                    "own" if owner_out else "repl",
+                    "don" if donate else "nodon",
                 ),
             )
             sp.note(
@@ -1212,7 +1374,9 @@ class MeshFusedIndex:
                 tier=local_b,
                 specs=b,
             )
-        pending = MeshPendingResults(out, b, pos, seq)
+        pending = MeshPendingResults(
+            out, b, pos, seq, owner_layout=owner_layout
+        )
         return pending if async_fetch else pending.fetch()
 
 
@@ -1232,6 +1396,7 @@ def _local_fused_query(
     gather_impl,
     sliced,
     has_counts,
+    owner_out=False,
 ):
     """Per-device body of the pod-local fused program.
 
@@ -1243,6 +1408,13 @@ def _local_fused_query(
     bisect/predicate work — and scatters its block into the global
     slot range before the same psum fan-in / ring row-gather
     reassemble replicated outputs.
+
+    ``owner_out=True`` (sliced only — the output diet, ISSUE 17)
+    skips BOTH combines: every local query is owned by construction,
+    so each device just returns its own [C]-block (rows already
+    rebased dataset-local, plane reductions local) and the outputs
+    leave the program owner-sharded (``out_specs P(axis)``) — no
+    psum, no ring pass, nothing replicated.
 
     ``masks``/``use_counts`` non-None arm the genotype-plane path:
     matched rows reduce under each query's own sample mask on the
@@ -1272,6 +1444,57 @@ def _local_fused_query(
     )(q)
     own_i = owned.astype(jnp.int32)
     c = int(enc["chrom"].shape[0])  # local batch (global/n_dev if sliced)
+
+    if sliced and owner_out:
+        # the output diet: every local query (and filler) is owned by
+        # construction, so the local [C]-block IS the final answer for
+        # these slots — no psum, no ring gather, outputs stay on their
+        # owning device (out_specs P(axis)). Ownership masking is kept
+        # as a structural-zero guard for any slot that could ever
+        # arrive misrouted.
+        mask = lambda x: x * _bcast(own_i, x)
+        agg = {
+            k: mask(res[k])
+            for k in (
+                "call_count",
+                "n_variants",
+                "all_alleles_count",
+                "n_matched",
+            )
+        }
+        agg["overflow"] = res["overflow"] & owned
+        agg["exists"] = agg["call_count"] > 0
+        rows = res["rows"]
+        agg["rows"] = jnp.where(
+            (rows >= 0) & owned[:, None],
+            rows - seg_base[q["shard"]][:, None],
+            jnp.int32(-1),
+        )
+        if masks is None:
+            return agg
+        rows_abs = res["rows"]
+        valid = rows_abs >= 0
+        n = arrs["pos"].shape[0]
+        safe = jnp.clip(rows_abs, 0, n - 1)
+        m = masks[:, None, :]  # [C, 1, W]
+        gt = arrays_local["plane_gt"][0][safe] & m  # [C, R, W]
+        pr = _plane_reduce(
+            arrs["flags"][safe],
+            arrs["ac"][safe].astype(jnp.int32),
+            arrs["an"][safe].astype(jnp.int32),
+            arrs["rec_id"][safe],
+            gt,
+            arrays_local["plane_gt2"][0][safe] & m if has_counts else None,
+            arrays_local["plane_tok1"][0][safe] & m if has_counts else None,
+            arrays_local["plane_tok2"][0][safe] & m if has_counts else None,
+            valid,
+            has_counts=has_counts,
+            use_counts=use_counts,
+        )
+        agg["pc_call"] = mask(pr["pc_call"])
+        agg["pc_tok"] = mask(pr["pc_tok"])
+        agg["or_words"] = mask(pr["or_words"])
+        return agg
 
     if sliced:
         # every local query is owned by construction (the host layout

@@ -13,9 +13,10 @@ the first request into an empty accumulator becomes the leader, waits up
 to ``max_wait_ms`` for followers (or until ``max_batch`` arrive), then
 executes the whole batch with one ``run_queries_auto`` call (scatter or
 XLA kernel by index type) and hands each waiter its row of the results.
-Batch-shape bucketing lives inside the kernels (kernel.BATCH_TIERS /
-the scatter chunk slots), so XLA compiles one program per tier instead
-of one per batch size.
+Batch-shape bucketing lives inside the kernels (the active
+kernel.TierLadder rungs — kernel.BATCH_TIERS is the legacy default —
+plus the scatter chunk slots), so XLA compiles one program per tier
+instead of one per batch size.
 
 Ingest-while-serving contract: the accumulators here are keyed by the
 DEVICE INDEX object (base shards, fused/mesh stacks), and delta shards
@@ -505,7 +506,8 @@ class MicroBatcher:
                             acc.items[head:] = tail
                     # cap by FLATTENED spec count, not submissions: a
                     # fused submit_many entry carries k specs, and a
-                    # batch whose flattened size tops kernel.BATCH_TIERS
+                    # batch whose flattened size tops the active tier
+                    # ladder (kernel.active_ladder().rungs)
                     # would compile a fresh exact-size program
                     # mid-request (the r4 soak tail). A single
                     # oversized submission still goes alone.
@@ -957,8 +959,9 @@ class MicroBatcher:
                 # launch-failure path (every waiter gets the error)
                 fault_point("kernel.launch")
                 # shape bucketing happens INSIDE the kernels (the XLA
-                # path pads to kernel.BATCH_TIERS, the scatter path to
-                # its fixed chunk slots) — pre-padding here doubled the
+                # path pads to the active tier ladder's rungs, the
+                # scatter path to its fixed chunk slots) — pre-padding
+                # here doubled the
                 # copy and turned pad rows into extra scatter dispatches
                 enc = encode_queries(specs, shard_ids=shard_ids)
                 t_enc = time.perf_counter()

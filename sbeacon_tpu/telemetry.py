@@ -1053,6 +1053,11 @@ class DeviceFlightRecorder:
         # (family, tier) -> [real, padded] for the tier-boundary view
         self._pad: dict[str, list] = {}
         self._pad_tier: dict[tuple, list] = {}
+        # output-diet accounting (ISSUE 17): bytes actually
+        # materialised on host by result fetches, and encode buffers
+        # donated to their launch instead of double-buffered in HBM
+        self._fetched_bytes = 0
+        self._donated = 0
         # compile tracker: first-seen (program, shape) keys
         self._compiles: dict[str, dict] = {}
         self._warmup_depth = 0
@@ -1101,6 +1106,7 @@ class DeviceFlightRecorder:
         launch_ms: float = 0.0,
         program_key=None,
         sliced: bool = False,
+        donated: int = 0,
     ) -> int:
         """Record ONE device launch; returns its sequence number (the
         handle :meth:`note_stage` later attaches encode/fetch timings
@@ -1122,6 +1128,8 @@ class DeviceFlightRecorder:
         }
         if sliced:
             rec["sliced"] = True
+        if donated:
+            rec["donated"] = int(donated)
         ctx = current_context()
         if ctx is not None:
             rec["traceId"] = ctx.trace_id
@@ -1134,6 +1142,7 @@ class DeviceFlightRecorder:
             if sliced:
                 self._sliced += 1
             self._pairs += int(evaluated_pairs)
+            self._donated += int(donated)
             pad = self._pad.setdefault(family, [0, 0])
             pad[0] += specs_real
             pad[1] += specs_padded
@@ -1184,13 +1193,18 @@ class DeviceFlightRecorder:
         return str(program_key)
 
     def note_stage(self, seq: int, *, encode_ms: float | None = None,
-                   fetch_ms: float | None = None) -> None:
+                   fetch_ms: float | None = None,
+                   fetch_bytes: int | None = None) -> None:
         """Attach a stage timing to a recorded launch (the encode
         happens before dispatch on the submitting thread, the fetch
         after it on the fetcher thread — neither is known at
-        :meth:`record_launch` time). No-op once the record has rolled
-        off the ring."""
+        :meth:`record_launch` time). The per-record annotation no-ops
+        once the record has rolled off the ring, but ``fetch_bytes``
+        still accumulates into the lifetime counter — ring eviction
+        must not leak fetched bytes out of ``device.fetched_bytes``."""
         with self._lock:
+            if fetch_bytes is not None:
+                self._fetched_bytes += int(fetch_bytes)
             rec = self._by_seq.get(seq)
             if rec is None:
                 return
@@ -1198,6 +1212,8 @@ class DeviceFlightRecorder:
                 rec["encodeMs"] = round(float(encode_ms), 3)
             if fetch_ms is not None:
                 rec["fetchMs"] = round(float(fetch_ms), 3)
+            if fetch_bytes is not None:
+                rec["fetchBytes"] = int(fetch_bytes)
 
     # -- back-compat module-property views ------------------------------------
 
@@ -1228,6 +1244,20 @@ class DeviceFlightRecorder:
     def evaluated_pairs(self) -> int:
         with self._lock:
             return self._pairs
+
+    @property
+    def fetched_bytes(self) -> int:
+        """Lifetime bytes result fetches materialised on host — the
+        owner-sharded output diet's structural evidence (ISSUE 17)."""
+        with self._lock:
+            return self._fetched_bytes
+
+    @property
+    def donated_buffers(self) -> int:
+        """Lifetime encode buffers donated to their launch instead of
+        double-buffered in HBM (the upload-path donation seam)."""
+        with self._lock:
+            return self._donated
 
     # -- read surfaces --------------------------------------------------------
 
@@ -1270,6 +1300,16 @@ class DeviceFlightRecorder:
         any launch — ``/debug/status`` diagnosis material."""
         with self._lock:
             return self._worst_pad_waste_locked()
+
+    def pad_tier_histogram(self) -> dict:
+        """{(family, tier): (real, padded)} spec-slot totals — the
+        traffic histogram ``ops.kernel.TierLadder.fit`` reads to split
+        wasteful rungs (ISSUE 17)."""
+        with self._lock:
+            return {
+                k: (int(v[0]), int(v[1]))
+                for k, v in self._pad_tier.items()
+            }
 
     def mid_request_compiles(self) -> int:
         with self._lock:
@@ -1328,6 +1368,8 @@ class DeviceFlightRecorder:
             families = dict(self._families)
             sliced = self._sliced
             pairs = self._pairs
+            fetched = self._fetched_bytes
+            donated = self._donated
             by_family = self._pad_waste_by_family_locked()
             by_tier = {
                 f"{family}:{tier}": round(1.0 - real / padded, 4)
@@ -1342,6 +1384,8 @@ class DeviceFlightRecorder:
             "byFamily": families,
             "sliced": sliced,
             "evaluatedPairs": pairs,
+            "fetchedBytes": fetched,
+            "donatedBuffers": donated,
             "ring": {"size": keep, "recorded": seq, "entries": ring},
             "padWaste": {
                 "byFamily": by_family,
@@ -1419,6 +1463,18 @@ def register_device_metrics(registry) -> None:
         "device-program compiles observed OUTSIDE a warmup phase (a "
         "novel batch shape paid its XLA compile inside a request)",
         fn=lambda: flight_recorder.mid_request_compiles(),
+    )
+    registry.counter(
+        "device.fetched_bytes",
+        "bytes result fetches materialised on host across all kernel "
+        "families (the owner-sharded output diet's structural metric)",
+        fn=lambda: flight_recorder.fetched_bytes,
+    )
+    registry.counter(
+        "device.donated_buffers",
+        "encoded query-batch buffers donated to their launch instead "
+        "of double-buffered in HBM (BEACON_DONATE_UPLOADS)",
+        fn=lambda: flight_recorder.donated_buffers,
     )
 
 

@@ -193,6 +193,8 @@ GOLDEN_DEVICE_KEYS = {
     "byFamily",
     "sliced",
     "evaluatedPairs",
+    "fetchedBytes",
+    "donatedBuffers",
     "ring",
     "padWaste",
     "compiles",
@@ -337,6 +339,45 @@ def test_warm_paths_record_zero_compile_events(warm_stack):
         )
     assert rec.mid_request_compiles() - c0 == 0
     assert journal.events(since=seq0, kind="device.compile") == []
+
+
+@obs
+def test_warmup_ladder_parity_lint_green_on_warm_stack(warm_stack):
+    """ISSUE 17 satellite: after warmup, EVERY rung of the active
+    TierLadder is covered by a warmup-phase compile — the fused host
+    ladder at every serving rung, the mesh tier at every slice rung up
+    to MESH_WARM_CAP, and the plane program at the same mesh shapes.
+    An uncovered cell is a batch shape that would pay a mid-request
+    compile, which test_warm_paths_record_zero_compile_events would
+    only catch for the specific shapes it happens to dispatch."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(
+        0, str(Path(__file__).resolve().parent.parent / "tools")
+    )
+    try:
+        from check_launch_recording import (
+            expected_warm_rungs,
+            lint_warmup_ladder,
+        )
+    finally:
+        sys.path.pop(0)
+    from sbeacon_tpu.ops.kernel import active_ladder
+
+    _app, _eng, tier, rec = warm_stack
+    state = tier._ready(wait=True)
+    assert state is not None
+    mesh_fams = (
+        ("mesh_sliced", "plane")
+        if state[0].has_planes
+        else ("mesh_sliced",)
+    )
+    expected = expected_warm_rungs(
+        active_ladder(), families=("fused",), mesh_families=mesh_fams
+    )
+    errs = lint_warmup_ladder(rec.compile_snapshot(), expected)
+    assert errs == [], errs
 
 
 @obs

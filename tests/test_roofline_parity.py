@@ -1,0 +1,356 @@
+"""Roofline-campaign parity (ISSUE 17): the adaptive tier ladder and
+the owner-sharded mesh output layout are PURE perf changes — every
+answer must stay byte-identical (``dataclasses.asdict``) to the legacy
+``BATCH_TIERS`` ladder and the replicated output layout, across
+boolean/count/record x selected-samples x delta-tail (L0) shapes.
+
+The ladder tests flip the process-global active ladder around the
+SAME index objects, so any divergence is the ladder's padding and
+nothing else; the mesh tests flip only ``owner_outputs`` on one
+``MeshFusedIndex``. Tier-1 safe (8 forced host devices via conftest).
+"""
+
+import dataclasses
+import random
+
+import jax
+import numpy as np
+import pytest
+
+from sbeacon_tpu.config import BeaconConfig, EngineConfig
+from sbeacon_tpu.engine import VariantEngine
+from sbeacon_tpu.index.columnar import build_index
+from sbeacon_tpu.ops.kernel import (
+    BATCH_TIERS,
+    FusedDeviceIndex,
+    L0DeviceIndex,
+    QuerySpec,
+    TierLadder,
+    encode_queries,
+    run_queries,
+    set_active_ladder,
+)
+from sbeacon_tpu.payloads import VariantQueryPayload
+from sbeacon_tpu.testing import random_records
+
+multi_device = pytest.mark.skipif(
+    len(jax.devices()) < 2,
+    reason="mesh parity needs >=2 devices (forced-host CI mesh)",
+)
+
+SAMPLES = ["S0", "S1"]
+
+
+def _shards(n=3, chrom="1", rows=200, seed=70):
+    return [
+        build_index(
+            random_records(
+                random.Random(seed + d), chrom=chrom, n=rows, n_samples=2
+            ),
+            dataset_id=f"d{d}",
+            vcf_location=f"v{d}",
+            sample_names=SAMPLES,
+        )
+        for d in range(n)
+    ]
+
+
+def _assert_results_byte_identical(a, b, label=""):
+    """dataclasses.asdict equality down to dtype and raw bytes — a
+    perf knob changing even a dtype would silently change response
+    payload sizes downstream."""
+    da, db = dataclasses.asdict(a), dataclasses.asdict(b)
+    assert da.keys() == db.keys(), label
+    for k in da:
+        va, vb = da[k], db[k]
+        if va is None or vb is None:
+            assert va is vb, (label, k)
+            continue
+        na, nb = np.asarray(va), np.asarray(vb)
+        assert na.dtype == nb.dtype, (label, k, na.dtype, nb.dtype)
+        assert na.shape == nb.shape, (label, k, na.shape, nb.shape)
+        assert na.tobytes() == nb.tobytes(), (label, k)
+
+
+def _legacy_ladder():
+    return TierLadder(BATCH_TIERS, source="test-legacy")
+
+
+# -- fit() convergence --------------------------------------------------------
+
+
+def test_ladder_fit_skips_skew_and_floor_and_converges():
+    """fit() must never chase waste it cannot fix: the bottom rung's
+    padding is the floor's known cost (a sub-floor rung would leak
+    process-wide — every 3-query batch padding to 4 instead of 8), and
+    slice-replicated families record padded = c_slot * n_dev, so their
+    waste measures owner SKEW. Both classes of cell must be ignored, and
+    re-fitting on the same histogram must be a fixed point — otherwise
+    each engine warmup() refit grows the ladder again."""
+    ladder = TierLadder(TierLadder.DEFAULT_RUNGS)
+    # sub-floor: 8 is the bottom rung, so an 87%-waste cell at 8 stays
+    assert ladder.fit({("fused", 8): (10, 80)}) is ladder
+    # slice-replicated families: pure skew, never a split
+    assert ladder.fit({("mesh_sliced", 16): (16, 1280)}) is ladder
+    assert ladder.fit({("plane", 16): (16, 1280)}) is ladder
+    # a genuinely wasteful serving rung splits once...
+    fitted = ladder.fit({("fused", 512): (650, 5120)})
+    assert 256 in fitted.rungs and fitted.source == "fit"
+    # ...and the same histogram is then a fixed point (idempotent
+    # warmup: warmup -> refit -> warmup must not compile new programs)
+    assert fitted.fit({("fused", 512): (650, 5120)}) is fitted
+
+
+# -- adaptive ladder vs legacy BATCH_TIERS ------------------------------------
+
+
+@pytest.mark.parametrize(
+    "cls", [FusedDeviceIndex, L0DeviceIndex], ids=["fused", "l0"]
+)
+def test_ladder_parity_byte_identical_kernel(cls):
+    """Odd batch sizes straddling the new rungs (3 -> 8, 9 -> 16,
+    33 -> 64) pad differently under the adaptive ladder than under
+    legacy (9 -> 64, 33 -> 64) — the answers must not notice, on the
+    base fused stack AND the L0 delta-tail mini-index (whose padded
+    segment-table shape is the delta-tail program signature)."""
+    shards = _shards()
+    dindex = cls(shards)
+    specs = [
+        QuerySpec("1", 1, 1 << 29, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("1", 500, 1500, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("1", 1, 1 << 29, 1, 1 << 30, alternate_bases="T"),
+    ]
+    pairs = [(sp, sid) for sp in specs for sid in range(len(shards))]
+
+    def run_all():
+        out = []
+        for b in (3, 9, 33):
+            batch = (pairs * ((b // len(pairs)) + 1))[:b]
+            enc = encode_queries(
+                [sp for sp, _ in batch],
+                shard_ids=[sid for _, sid in batch],
+            )
+            out.append(
+                run_queries(dindex, enc, window_cap=2048, record_cap=64)
+            )
+        return out
+
+    set_active_ladder(_legacy_ladder())
+    try:
+        legacy = run_all()
+    finally:
+        set_active_ladder(None)
+    adaptive = run_all()
+    for b, la, ad in zip((3, 9, 33), legacy, adaptive):
+        _assert_results_byte_identical(la, ad, label=f"b={b}")
+
+
+def test_ladder_parity_byte_identical_engine_granularities():
+    """Engine-level: boolean/count/record x selected-samples payloads
+    answer byte-identically under the adaptive and legacy ladders —
+    the serving micro-batcher, host materialisation and response
+    shaping all sit downstream of the pad seam the ladder moved."""
+    eng = VariantEngine(
+        BeaconConfig(
+            engine=EngineConfig(
+                use_mesh=False,
+                microbatch_wait_ms=0.0,
+                response_cache=False,
+            )
+        )
+    )
+    for s in _shards():
+        eng.add_index(s)
+    try:
+        payloads = []
+        for gran in ("boolean", "count", "record"):
+            payloads.append(
+                VariantQueryPayload(
+                    dataset_ids=[f"d{d}" for d in range(3)],
+                    reference_name="1",
+                    start_min=1,
+                    start_max=1 << 29,
+                    end_min=1,
+                    end_max=1 << 30,
+                    alternate_bases="N",
+                    requested_granularity=gran,
+                    include_datasets="HIT",
+                )
+            )
+        sel = VariantQueryPayload(
+            dataset_ids=[f"d{d}" for d in range(3)],
+            reference_name="1",
+            start_min=1,
+            start_max=1 << 29,
+            end_min=1,
+            end_max=1 << 30,
+            alternate_bases="N",
+            requested_granularity="record",
+            include_datasets="HIT",
+            selected_samples_only=True,
+            sample_names={f"d{d}": ["S0"] for d in range(3)},
+        )
+        payloads.append(sel)
+        set_active_ladder(_legacy_ladder())
+        try:
+            legacy = [
+                [dataclasses.asdict(r) for r in eng.search(q)]
+                for q in payloads
+            ]
+        finally:
+            set_active_ladder(None)
+        adaptive = [
+            [dataclasses.asdict(r) for r in eng.search(q)]
+            for q in payloads
+        ]
+        for q, la, ad in zip(payloads, legacy, adaptive):
+            assert la == ad, q.requested_granularity
+    finally:
+        eng.close()
+
+
+def test_ladder_parity_delta_tail():
+    """Delta-tail shapes: a base shard plus a raw delta tail answers
+    byte-identically under both ladders — the per-target delta path
+    and the L0 stacking both pad batches through the same ladder."""
+    recs = random_records(random.Random(81), chrom="1", n=240, n_samples=2)
+
+    def build():
+        eng = VariantEngine(
+            BeaconConfig(
+                engine=EngineConfig(
+                    use_mesh=False,
+                    microbatch_wait_ms=0.0,
+                    response_cache=False,
+                )
+            )
+        )
+        eng.add_index(
+            build_index(
+                recs[:160],
+                dataset_id="dsA",
+                vcf_location="a.vcf",
+                sample_names=SAMPLES,
+            )
+        )
+        for lo in (160, 200):
+            eng.add_delta(
+                build_index(
+                    recs[lo : lo + 40],
+                    dataset_id="dsA",
+                    vcf_location="a.vcf",
+                    sample_names=SAMPLES,
+                )
+            )
+        return eng
+
+    q = VariantQueryPayload(
+        dataset_ids=["dsA"],
+        reference_name="1",
+        start_min=1,
+        start_max=1 << 29,
+        end_min=1,
+        end_max=1 << 30,
+        alternate_bases="N",
+        requested_granularity="record",
+        include_datasets="HIT",
+    )
+    eng = build()
+    try:
+        set_active_ladder(_legacy_ladder())
+        try:
+            legacy = [dataclasses.asdict(r) for r in eng.search(q)]
+        finally:
+            set_active_ladder(None)
+        adaptive = [dataclasses.asdict(r) for r in eng.search(q)]
+        assert legacy == adaptive
+    finally:
+        eng.close()
+
+
+# -- owner-sharded vs replicated mesh outputs ---------------------------------
+
+
+@multi_device
+def test_owner_sharded_parity_byte_identical():
+    """The owner-sharded output layout (out_specs P('d'), per-owner
+    slice fetch) must answer byte-identically to the replicated layout
+    across match and plane (selected-samples) programs, balanced and
+    skewed batches — while fetching FEWER bytes off the device."""
+    import sbeacon_tpu.telemetry as tel
+    from sbeacon_tpu.parallel.mesh import MeshFusedIndex, make_mesh
+
+    shards = _shards(5, chrom="7", rows=250)
+    mfi = MeshFusedIndex(shards, make_mesh(), with_planes=True)
+    specs = [
+        QuerySpec("7", 1, 1 << 29, 1, 1 << 30, alternate_bases="N"),
+        QuerySpec("7", 900, 1600, 1, 1 << 30, alternate_bases="N"),
+    ]
+    balanced = [(sp, sid) for sp in specs for sid in range(5)]
+    skewed = [(sp, 0) for sp in specs for _ in range(4)]
+    rec = tel.flight_recorder
+    for name, pairs in (("balanced", balanced), ("skewed", skewed)):
+        enc = encode_queries(
+            [sp for sp, _ in pairs], shard_ids=[sid for _, sid in pairs]
+        )
+        f0 = rec.fetched_bytes
+        own = mfi.run_mesh_queries(
+            dict(enc),
+            window_cap=2048,
+            record_cap=64,
+            owner_outputs=True,
+        )
+        owner_bytes = rec.fetched_bytes - f0
+        f0 = rec.fetched_bytes
+        repl = mfi.run_mesh_queries(
+            dict(enc),
+            window_cap=2048,
+            record_cap=64,
+            owner_outputs=False,
+        )
+        repl_bytes = rec.fetched_bytes - f0
+        _assert_results_byte_identical(own, repl, label=name)
+        # the output-diet claim: the owner fetch trims each device's
+        # block to its real count instead of pulling a full replica
+        assert owner_bytes < repl_bytes, (name, owner_bytes, repl_bytes)
+        # plane program at the same shapes
+        masks = np.full(
+            (len(pairs), mfi.plane_words), 0xFFFFFFFF, np.uint32
+        )
+        mc = np.zeros(len(pairs), np.bool_)
+        own_p = mfi.run_mesh_queries(
+            dict(enc),
+            window_cap=2048,
+            record_cap=64,
+            sample_masks=masks,
+            mask_counts=mc,
+            owner_outputs=True,
+        )
+        repl_p = mfi.run_mesh_queries(
+            dict(enc),
+            window_cap=2048,
+            record_cap=64,
+            sample_masks=masks,
+            mask_counts=mc,
+            owner_outputs=False,
+        )
+        _assert_results_byte_identical(own_p, repl_p, label=f"{name}-plane")
+
+
+@multi_device
+def test_owner_sharded_fetch_never_materializes_replicas():
+    """Satellite bugfix guard: the owner fetch path slices each
+    device's OWN block (shape [c_slot, ...]) — a full-size replica
+    arriving at the host would defeat the output diet. The fetch
+    asserts per-shard shape internally; this exercises it on a batch
+    where c_slot x n_dev is much larger than the real batch."""
+    from sbeacon_tpu.parallel.mesh import MeshFusedIndex, make_mesh
+
+    shards = _shards(2, chrom="7", rows=120)
+    mfi = MeshFusedIndex(shards, make_mesh())
+    spec = QuerySpec("7", 1, 1 << 29, 1, 1 << 30, alternate_bases="N")
+    enc = encode_queries([spec] * 6, shard_ids=[0] * 6)
+    res = mfi.run_mesh_queries(
+        dict(enc), window_cap=2048, record_cap=64, owner_outputs=True
+    )
+    assert res.exists.shape == (6,)

@@ -241,6 +241,8 @@ GOLDEN_METRICS = [
     "device.evaluated_pairs",
     "device.pad_waste",
     "device.mid_request_compiles",
+    "device.fetched_bytes",
+    "device.donated_buffers",
     "migration.started",
     "migration.completed",
     "migration.rolled_back",
@@ -699,6 +701,85 @@ def test_launch_recording_lint_catches_violations():
         'X = "fused_l0"\nDEVICE_FAMILIES = ("fused",)\n',
     )
     assert len(errs) == 1 and "DEVICE_FAMILIES" in errs[0]
+    # the donated jit twin must stay behind the same door (ISSUE 17)
+    errs = lint_jit_bypass(
+        "sbeacon_tpu/engine.py",
+        "from .ops.kernel import _query_batch_donated\n"
+        "def serve(arrays, enc):\n"
+        "    return _query_batch_donated(arrays, enc, window_cap=1,\n"
+        "                                record_cap=1, n_iters=1)\n",
+    )
+    assert len(errs) == 1 and "_query_batch_donated" in errs[0]
+
+
+@obs
+def test_warmup_ladder_lint_catches_violations():
+    """ISSUE 17 satellite: the warmup-ladder parity lint over a
+    compile snapshot — an active-ladder rung with no warmup-phase
+    compile, or a plane-capable family warming only one of its two
+    programs per rung, must fail."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_launch_recording import (
+            expected_warm_rungs,
+            lint_warmup_ladder,
+        )
+    finally:
+        sys.path.pop(0)
+    from sbeacon_tpu.ops.kernel import TierLadder
+
+    def entry(family, tier, key, warmup=True):
+        return {
+            "key": key,
+            "family": family,
+            "tier": tier,
+            "warmup": warmup,
+        }
+
+    # full coverage passes — snapshot-dict and bare-list forms alike
+    snap = {
+        "entries": [
+            entry("fused", 8, "f:8"),
+            entry("fused", 64, "f:64"),
+            entry("mesh_sliced", 1, "m:1:match"),
+            entry("mesh_sliced", 1, "m:1:plane"),
+        ]
+    }
+    expected = {"fused": (8, 64), "mesh_sliced": (1,)}
+    assert lint_warmup_ladder(snap, expected) == []
+    assert lint_warmup_ladder(snap["entries"], expected) == []
+    # an uncovered rung fails, naming family and tier
+    errs = lint_warmup_ladder(snap, {"fused": (8, 16, 64)})
+    assert len(errs) == 1 and "fused" in errs[0] and "16" in errs[0]
+    # a compile stamped OUTSIDE warmup does not count as coverage
+    errs = lint_warmup_ladder(
+        [entry("fused", 8, "f:8", warmup=False)], {"fused": (8,)}
+    )
+    assert len(errs) == 1 and "warmup" in errs[0]
+    # a plane-capable family needs BOTH programs per rung
+    errs = lint_warmup_ladder(
+        [entry("mesh_sliced", 1, "m:1:match")],
+        {"mesh_sliced": (1,)},
+        plane_families=("mesh_sliced",),
+    )
+    assert len(errs) == 1 and "plane" in errs[0]
+    assert (
+        lint_warmup_ladder(
+            snap,
+            {"mesh_sliced": (1,)},
+            plane_families=("mesh_sliced",),
+        )
+        == []
+    )
+    # the expected-map helper mirrors the warmup loops: host families
+    # warm every serving rung, mesh families the capped slice rungs
+    lad = TierLadder((8, 16, 32, 64, 512, 2048))
+    exp = expected_warm_rungs(
+        lad, families=("fused",), mesh_families=("mesh_sliced", "plane")
+    )
+    assert exp["fused"] == (8, 16, 32, 64, 512, 2048)
+    assert exp["mesh_sliced"] == (1, 8, 16, 32, 64)
+    assert exp["plane"] == exp["mesh_sliced"]
 
 
 # -- annotation-key lint (ISSUE 11 satellite) ----------------------------------

@@ -24,11 +24,21 @@ This lint keeps it that way:
   one of these files cannot silently drop out of the flight recorder;
 - the L0 delta-tail mini-index (ISSUE 15) must stay inside the
   recorded seam: no module other than ``ops/kernel.py`` may call the
-  jitted ``_query_batch`` entry directly (a dispatch bypassing
-  ``run_queries`` would be invisible to the flight recorder), the
-  ``L0DeviceIndex`` class must pin ``flight_family = "fused_l0"``
-  (its launches are attributable separately from the base fused
-  stack), and ``telemetry.DEVICE_FAMILIES`` must carry the family.
+  jitted ``_query_batch`` / ``_query_batch_donated`` entries directly
+  (a dispatch bypassing ``run_queries`` would be invisible to the
+  flight recorder), the ``L0DeviceIndex`` class must pin
+  ``flight_family = "fused_l0"`` (its launches are attributable
+  separately from the base fused stack), and
+  ``telemetry.DEVICE_FAMILIES`` must carry the family.
+
+It also carries the RUNTIME warmup-ladder parity check
+(``lint_warmup_ladder``, ISSUE 17 satellite): given a flight-recorder
+compile snapshot and the rungs the active ``TierLadder`` serves, every
+(family, rung) cell must hold a warmup-stamped compile — and for
+plane-capable families both the match AND the plane program — so
+``device.mid_request_compiles`` stays zero for any batch the ladder
+can emit. The static ``main()`` pass cannot observe compiles, so this
+check runs from ``tests/test_telemetry.py`` against a warmed engine.
 
 Run directly (``python tools/check_launch_recording.py``) or via the
 tier-1 test ``tests/test_telemetry.py::test_launch_recording_lint``.
@@ -61,10 +71,13 @@ KERNEL_SEAMS = (
 #: the recorder entry points a kernel seam must call
 RECORD_CALLS = frozenset({"record_device_launch", "record_launch"})
 
-#: the jitted query-batch entry: only its own module (the recorded
-#: run_queries seam) may invoke it — an L0 (or any) dispatch calling
-#: it directly would launch device programs the recorder never sees
+#: the jitted query-batch entries: only their own module (the recorded
+#: run_queries seam) may invoke them — an L0 (or any) dispatch calling
+#: one directly would launch device programs the recorder never sees.
+#: The donated variant (ISSUE 17) is the same program with buffer
+#: donation and must stay behind the same door.
 JIT_ENTRY = "_query_batch"
+JIT_ENTRIES = frozenset({"_query_batch", "_query_batch_donated"})
 JIT_ENTRY_HOME = "ops/kernel.py"
 
 
@@ -118,8 +131,9 @@ def lint_module(rel: str, src: str) -> list[str]:
 
 
 def lint_jit_bypass(rel: str, src: str) -> list[str]:
-    """No module outside the kernel seam may call ``_query_batch``
-    directly — the recorded ``run_queries`` entry is the only door."""
+    """No module outside the kernel seam may call ``_query_batch`` (or
+    its donated twin) directly — the recorded ``run_queries`` entry is
+    the only door."""
     if rel.replace("\\", "/").endswith(JIT_ENTRY_HOME):
         return []
     try:
@@ -136,14 +150,81 @@ def lint_jit_bypass(rel: str, src: str) -> list[str]:
             if isinstance(fn, ast.Name)
             else fn.attr if isinstance(fn, ast.Attribute) else None
         )
-        if name == JIT_ENTRY:
+        if name in JIT_ENTRIES:
             errors.append(
-                f"{rel}:{node.lineno}: direct {JIT_ENTRY} call — "
+                f"{rel}:{node.lineno}: direct {name} call — "
                 "dispatch through ops.kernel.run_queries (the "
                 "flight-recorder seam); a bypassed launch is "
                 "invisible to /device/status and the compile tracker"
             )
     return errors
+
+
+def lint_warmup_ladder(
+    snapshot,
+    expected,
+    plane_families=(),
+) -> list[str]:
+    """Warmup-ladder parity (ISSUE 17 satellite).
+
+    ``snapshot`` is a flight-recorder compile snapshot
+    (``DeviceFlightRecorder.compile_snapshot()`` — a dict whose
+    ``entries`` list holds ``{key, family, tier, warmup}`` records) or
+    a bare entry list. ``expected`` maps each launch family to the
+    batch-tier rungs the active ``TierLadder`` can pad a request to.
+    Every (family, rung) cell must be covered by a compile stamped
+    inside a ``device_warmup_phase`` — an uncovered rung is exactly a
+    batch shape that would pay a mid-request compile the first time
+    traffic coalesces to it. Families in ``plane_families`` dispatch a
+    SECOND compiled program for selected-samples planes at the same
+    rungs, so their cells need at least two distinct warm program
+    keys (match + plane).
+    """
+    entries = (
+        snapshot.get("entries", [])
+        if isinstance(snapshot, dict)
+        else list(snapshot)
+    )
+    warm: dict = {}
+    for e in entries:
+        if not e.get("warmup"):
+            continue
+        cell = (e.get("family"), int(e.get("tier", -1)))
+        warm.setdefault(cell, set()).add(e.get("key"))
+    errors: list[str] = []
+    for family in sorted(expected):
+        need = 2 if family in plane_families else 1
+        for t in sorted({int(r) for r in expected[family]}):
+            keys = warm.get((family, t), set())
+            if not keys:
+                errors.append(
+                    f"{family}: ladder rung {t} has no warmup-phase "
+                    "compile — the first request batch padded to this "
+                    "tier pays a mid-request compile"
+                )
+            elif len(keys) < need:
+                errors.append(
+                    f"{family}: ladder rung {t} warmed only "
+                    f"{len(keys)} program(s) — the match AND plane "
+                    "programs must both be covered"
+                )
+    return errors
+
+
+def expected_warm_rungs(
+    ladder,
+    families=("fused",),
+    mesh_families=(),
+) -> dict:
+    """The (family → rungs) map ``lint_warmup_ladder`` checks, derived
+    from one ``TierLadder``. Host-padded families warm every serving
+    rung (``ladder.rungs``); mesh families key programs on the
+    PER-DEVICE slice tier, so they warm ``ladder.mesh_warm_rungs()``
+    (slice rungs at or under ``MESH_WARM_CAP`` — larger rungs are bulk
+    shapes outside the serving path)."""
+    exp = {f: tuple(ladder.rungs) for f in families}
+    exp.update({f: tuple(ladder.mesh_warm_rungs()) for f in mesh_families})
+    return exp
 
 
 def lint_l0_family(kernel_src: str, telemetry_src: str) -> list[str]:
