@@ -24,7 +24,12 @@ import math
 import time
 from pathlib import Path
 
-from ..accounting import CostAccounting, disabled_snapshot, query_shape
+from ..accounting import (
+    CostAccounting,
+    cost_units,
+    disabled_snapshot,
+    query_shape,
+)
 from ..canary import CanaryProber
 from ..config import BeaconConfig, StorageConfig
 from ..engine import VariantEngine
@@ -33,6 +38,13 @@ from ..ingest.service import VcfLocationError
 from ..harness import faults
 from ..metadata import MetadataStore, OntologyStore
 from ..metadata.filters import FilterError
+from ..plan import (
+    PlanStore,
+    plan_document,
+    plan_note,
+    plan_stage,
+    register_plan_metrics,
+)
 from ..query_jobs import AsyncQueryRunner, QueryJobTable
 from ..resilience import (
     NO_DEADLINE,
@@ -114,6 +126,12 @@ def strip_private(doc: dict) -> dict:
     """Drop '_'-prefixed internal fields (reference jsons.dump
     strip_privates=True on every record response)."""
     return {k: v for k, v in doc.items() if not k.startswith("_")}
+
+
+def _wants_explain(query_params: dict | None) -> bool:
+    """``?explain=1`` (or true/yes/on) — the inline plan request."""
+    raw = str((query_params or {}).get("explain") or "").lower()
+    return raw in ("1", "true", "yes", "on")
 
 
 def _header(headers: dict | None, name: str) -> str | None:
@@ -288,6 +306,19 @@ class BeaconApp:
             obs, max_tenants=self.config.shaping.max_tenants
         )
         self.slo.add_breach_listener(self.shaping.on_slo_signal)
+        # execution-plan plane (plan.py): sampled per-request plan
+        # documents aggregated by (query-shape, plan-shape) and served
+        # at /ops/plans, with the drift sentinel's observation window
+        # tied to the canary interval — the prober's round loop rolls
+        # the window, so a dominant-shape flip (mesh quietly refusing
+        # planes, L0 coverage collapsing to tail walks) is diagnosed
+        # within one canary round even on a coordinator with no
+        # organic traffic
+        self.plans = PlanStore(
+            sample_n=getattr(obs, "plan_sample_n", 16),
+            drift_windows=getattr(obs, "plan_drift_windows", 2),
+            window_s=getattr(obs, "canary_interval_s", 30.0),
+        )
         # known-answer canary prober (canary.py): expected-answer
         # probes derived from the serving snapshot, run per query
         # shape x dispatch path under the synthetic 'canary' route —
@@ -298,6 +329,7 @@ class BeaconApp:
             interval_s=getattr(obs, "canary_interval_s", 30.0),
             enabled=getattr(obs, "canary_enabled", True),
             latency_ms=getattr(obs, "canary_latency_ms", 1000.0),
+            plan_store=self.plans,
         )
         self.canary.start()
         # flight recorder: the process journal was built from env
@@ -407,6 +439,7 @@ class BeaconApp:
             # registration keeps a second app from double-registering
             register_device_metrics(reg)
         self.canary.register_metrics(reg)
+        register_plan_metrics(reg, self.plans)
         register_admission_metrics(reg, lambda: self.admission)
         self.shaping.register_metrics(reg)
         self.query_runner.register_metrics(reg)
@@ -571,12 +604,29 @@ class BeaconApp:
                 query_shape(route, ctx.notes.get("granularity")),
                 cost.snapshot(),
             )
+        # execution-plan fold: tracked requests' stage trails aggregate
+        # by (query-shape, plan-shape) for /ops/plans and the drift
+        # sentinel. Probe/diagnostic routes are excluded exactly like
+        # SLO budgets and the cost fold — the canary folds its own
+        # probes under bounded synthetic shapes instead.
+        if self.slo.tracked(route):
+            self.plans.observe(
+                query_shape(route, ctx.notes.get("granularity")),
+                ctx.plan,
+                units=cost_units(ctx.cost.snapshot()),
+                trace_id=ctx.trace_id,
+            )
         notes = ctx.notes
         if ctx.cost.nonzero():
             # slow-query records carry the cost decomposition: a tail
             # is attributable to device time vs host scan vs worker
             # RTT without cross-referencing /ops/costs
             notes = {**notes, "cost": ctx.cost.as_dict()}
+        if ctx.plan:
+            # ... and the plan fingerprint + any refusal reasons: a
+            # slow record says WHICH road the query took (and which it
+            # was refused) without a second lookup
+            notes = {**notes, "plan": plan_note(ctx)}
         self.slow_log.maybe_record(
             trace_id=ctx.trace_id,
             route=route,
@@ -589,6 +639,11 @@ class BeaconApp:
             if isinstance(meta, dict):
                 meta["traceId"] = ctx.trace_id
                 meta["elapsedTimeMs"] = round(elapsed_ms, 2)
+                if ctx.explain:
+                    # ?explain=1 (gated in _handle): the full bounded
+                    # plan document rides the envelope — never cached,
+                    # since explain forces no_response_cache
+                    meta["executionPlan"] = plan_document(ctx)
                 unavailable = ctx.notes.get("unavailable_datasets")
                 if unavailable:
                     # partial-results degradation (dispatch.search):
@@ -625,6 +680,16 @@ class BeaconApp:
                 denied = self._check_auth(method.upper(), path, headers)
                 if denied is not None:
                     return denied
+                if _wants_explain(query_params):
+                    denied = self._check_explain(headers)
+                    if denied is not None:
+                        return denied
+                    ctx = current_context()
+                    if ctx is not None:
+                        # armed only after the gate: an unauthorized
+                        # ?explain=1 never records, never bypasses the
+                        # response cache, never changes the answer
+                        ctx.explain = True
                 deadline = self._request_deadline(head, headers)
                 # traffic shaping: classify tenant (header/API key/anon
                 # bucket) and priority lane (interactive boolean-count
@@ -636,6 +701,7 @@ class BeaconApp:
                 lane = self.shaping.lane_of(head, query_params, body)
                 granularity = requested_granularity(query_params, body)
                 annotate(tenant=tenant, lane=lane)
+                plan_stage("admission", decision=lane, tenant=tenant)
                 if granularity:
                     annotate(granularity=granularity)
                 # the query-shape key (route x granularity): the same
@@ -742,6 +808,12 @@ class BeaconApp:
             if self.accounting is None:
                 return 200, disabled_snapshot()
             return 200, self.accounting.snapshot()
+        if head == "ops/plans":
+            # the execution-plan plane's rollup: per (query-shape,
+            # plan-shape) counts, cost-unit means, exemplar trace ids
+            # (resolvable through /_trace when tracing is on), and the
+            # drift sentinel's recent dominant-shape flips
+            return 200, self.plans.snapshot()
         if head == "fleet/status":
             # fleet-wide federation rollup: every worker's /ops/digest
             # collected at a bounded cadence + the coordinator's own
@@ -854,6 +926,7 @@ class BeaconApp:
                     "hottestWorker": None,
                     "divergentDatasets": {},
                     "unreachableWorkers": [],
+                    "worstCompilingReplica": None,
                 },
             }
         else:
@@ -1002,6 +1075,11 @@ class BeaconApp:
             "midRequestCompiles": recorder.mid_request_compiles(),
         }
         last_compile = recorder.last_mid_request_compile()
+        # execution-plan rollup: observation/sample counters + the
+        # drift sentinel's recent dominant-shape flips, with the
+        # diagnosis naming the drifted query shapes next to the
+        # breaches and canary mismatches they often explain
+        plans = self.plans.counters()
         return {
             "ready": bool(self.ready),
             "beaconId": self.config.info.beacon_id,
@@ -1014,6 +1092,7 @@ class BeaconApp:
             "costs": costs,
             "canary": canary,
             "device": device,
+            "plans": plans,
             "events": {
                 "lastSeq": journal.last_seq(),
                 "published": journal.published(),
@@ -1037,6 +1116,7 @@ class BeaconApp:
                 "lastMidRequestCompile": (
                     last_compile["key"] if last_compile else None
                 ),
+                "planDrift": self.plans.drifted_shapes(),
             },
         }
 
@@ -1090,6 +1170,39 @@ class BeaconApp:
         if injector is not None:
             out["faults"] = injector.stats()
         return out
+
+    def _check_explain(self, headers) -> tuple[int, dict] | None:
+        """404/401/403 envelope for an unauthorized ``?explain=1``,
+        else None (explain may proceed).
+
+        The plan document names internal topology — worker URLs, mesh
+        shard counts, HBM headroom — so it rides the WORKER-token trust
+        boundary exactly like ``/fleet/migrate``: disabled entirely
+        unless ``BEACON_EXPLAIN_ENABLED`` (a 404, indistinguishable
+        from the feature not existing), then no credential -> 401,
+        wrong credential -> 403. Empty worker token = open (dev mode /
+        private network), matching the worker endpoints themselves."""
+        if not getattr(
+            self.config.observability, "explain_enabled", False
+        ):
+            return 404, self.env.error(
+                404, "explain disabled (set BEACON_EXPLAIN_ENABLED)"
+            )
+        token = self.config.auth.worker_token
+        if not token:
+            return None
+        got = _authorization_header(headers or {})
+        if not got:
+            return 401, self.env.error(
+                401, "missing Authorization header"
+            )
+        if not hmac.compare_digest(
+            got.encode(), f"Bearer {token}".encode()
+        ):
+            return 403, self.env.error(
+                403, "explain requires the worker token"
+            )
+        return None
 
     def _check_auth(self, method, path, headers) -> tuple[int, dict] | None:
         """401/403 envelope for unauthorized mutating requests, else None.

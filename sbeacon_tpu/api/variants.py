@@ -9,9 +9,11 @@ Beacon aggregation loop (reference: getGenomicVariants/route_g_variants.py:
 from __future__ import annotations
 
 import base64
+import dataclasses
 
 from ..metadata.filters import entity_search_conditions
 from ..payloads import VariantQueryPayload
+from ..plan import explain_active
 from ..utils.chrom import normalize_chromosome
 from .envelopes import variant_entry
 from .requests import BeaconRequest, RequestError
@@ -200,6 +202,13 @@ def run_variant_search(
         sample_names=samples_by_dataset if selected else {},
         selected_samples_only=selected,
     )
+    if explain_active():
+        # an explained request must describe a LIVE execution of
+        # exactly this query: never served from (or written to) the
+        # response cache, and never coalesced onto a query job whose
+        # plan belongs to some earlier request
+        payload = dataclasses.replace(payload, no_response_cache=True)
+        runner = None
     if runner is not None:
         from ..query_jobs import JobStatus
         from ..resilience import current_deadline

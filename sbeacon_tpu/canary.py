@@ -147,11 +147,17 @@ class CanaryProber:
         enabled: bool = True,
         latency_ms: float = 1000.0,
         clock=time.monotonic,
+        plan_store=None,
     ):
         self.engine = engine
         self.interval_s = float(interval_s)
         self.enabled = bool(enabled)
         self.latency_ms = float(latency_ms)
+        # execution-plan fold (plan.py): each probe's stage trail is
+        # observed under a bounded synthetic query shape, and the
+        # round loop rolls the sentinel's observation window — drift
+        # detection works on a coordinator with zero organic traffic
+        self.plan_store = plan_store
         self._clock = clock
         self._lock = threading.Lock()
         self._probes: list[CanaryProbe] = []
@@ -284,6 +290,17 @@ class CanaryProber:
                         getattr(r, "exists", False) for r in responses
                     )
                     ran += 1
+                    if self.plan_store is not None and ctx.plan:
+                        # shape x path, NOT per probe id: the same
+                        # known-answer query must produce the same
+                        # plan, so every probe of a shape folds into
+                        # one bounded aggregate whose dominant-plan
+                        # flip IS the drift signal
+                        self.plan_store.observe(
+                            f"canary:{shape}:{path_name}",
+                            ctx.plan,
+                            trace_id=ctx.trace_id,
+                        )
                     if exists != probe.expect_exists:
                         mism += 1
                         label = f"{probe.probe_id}:{shape}@{path_name}"
@@ -308,6 +325,11 @@ class CanaryProber:
                         )
                     elif elapsed_ms > self.latency_ms:
                         slow += 1
+        if self.plan_store is not None:
+            # close the sentinel's observation window at round
+            # granularity: a dominant-shape flip seeded this round is
+            # journaled before the round's summary lands
+            self.plan_store.roll_window()
         summary = {
             "probes": ran,
             "mismatches": mism,

@@ -504,6 +504,21 @@ class ObservabilityConfig:
     migration_copy_timeout_s: wall budget for the copy phase; also
       the base of the stuck-migration diagnosis
       (``BEACON_MIGRATION_COPY_TIMEOUT_S``).
+
+    Execution-plan plane (plan.py, served at ``GET /ops/plans``;
+    ISSUE 19):
+    explain_enabled: serve ``?explain=1`` inline execution plans under
+      ``meta.executionPlan`` (``BEACON_EXPLAIN_ENABLED``; worker-token
+      protected when one is set — 404 when disabled, 401/403 on a
+      missing/bad token). The sampled plan store and drift sentinel
+      run regardless; this gates only the inline surface.
+    plan_sample_n: retain the full stage document for every Nth
+      observation per (query-shape, plan-shape) aggregate
+      (``BEACON_PLAN_SAMPLE_N``; counting is always exact — sampling
+      bounds only the retained exemplar documents).
+    plan_drift_windows: closed observation windows retained per
+      query-shape for the dominant-plan-shape comparison
+      (``BEACON_PLAN_DRIFT_WINDOWS``, floor 2: newest vs previous).
     """
 
     slow_query_ms: float = 1000.0
@@ -527,6 +542,9 @@ class ObservabilityConfig:
     migration_enabled: bool = True
     migration_verify_rounds: int = 3
     migration_copy_timeout_s: float = 120.0
+    explain_enabled: bool = False
+    plan_sample_n: int = 16
+    plan_drift_windows: int = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -771,6 +789,8 @@ class BeaconConfig:
                 "migration_copy_timeout_s",
                 float,
             ),
+            "BEACON_PLAN_SAMPLE_N": ("plan_sample_n", int),
+            "BEACON_PLAN_DRIFT_WINDOWS": ("plan_drift_windows", int),
         }
         for var, (field, conv) in _obs_env.items():
             if var in env:
@@ -790,6 +810,10 @@ class BeaconConfig:
         if "BEACON_MIGRATION_ENABLED" in env:
             obs_over["migration_enabled"] = (
                 env["BEACON_MIGRATION_ENABLED"].lower() not in _off
+            )
+        if "BEACON_EXPLAIN_ENABLED" in env:
+            obs_over["explain_enabled"] = (
+                env["BEACON_EXPLAIN_ENABLED"].lower() not in _off
             )
         if "BEACON_COST_ACCOUNTING" in env:
             obs_over["cost_accounting"] = (

@@ -38,6 +38,7 @@ from .index.columnar import FLAG, VariantIndexShard
 from .ops import make_device_index, run_queries_auto
 from .ops.kernel import QuerySpec, encode_queries
 from .payloads import VariantQueryPayload, VariantSearchResponse
+from .plan import plan_stage
 from .response_cache import (
     ResponseCache,
     response_cache_key,
@@ -2107,10 +2108,13 @@ class VariantEngine:
             hit = cache.get(key)
             if hit is not None:
                 annotate(response_cache="hit")
+                plan_stage("cache", decision="hit")
                 return hit
             scope = response_cache_scope(payload)
             gen = cache.generation()
-        annotate(response_cache="miss" if cache is not None else "off")
+        outcome = "miss" if cache is not None else "off"
+        annotate(response_cache=outcome)
+        plan_stage("cache", decision=outcome)
         with span("engine.search") as sp:
             responses = self._search(payload, sp)
         if key is not None:
@@ -2518,6 +2522,9 @@ class VariantEngine:
                 t for t in targets if (t[0], t[1]) not in mesh_responses
             ]
             if not targets:
+                plan_stage(
+                    "split", decision="mesh_all", mesh=len(mesh_responses)
+                )
                 return list(mesh_responses.values())
 
         # the L0 leg of the three-way split: delta-tail targets the
@@ -2546,6 +2553,29 @@ class VariantEngine:
             self._fused_multi_rows(targets, spec_base, payload)
             if len(targets) > 1
             else None
+        )
+
+        # the per-target fan-out as decided on this thread: counts per
+        # serving leg, with the overflow buckets (rows already marked
+        # None) that will walk the host matcher instead of the leg
+        # that pre-matched them
+        plan_stage(
+            "split",
+            decision="fanout",
+            mesh=len(mesh_responses) if mesh_responses else 0,
+            l0=sum(1 for r in l0_rows.values() if r is not None),
+            delta_tail_host=sum(1 for r in l0_rows.values() if r is None),
+            fused=sum(
+                1
+                for k, r in (pre_rows or {}).items()
+                if r is not None and k not in l0_rows
+            ),
+            fused_overflow_host=sum(
+                1
+                for k, r in (pre_rows or {}).items()
+                if r is None and k not in l0_rows
+            ),
+            scatter=len(targets),
         )
 
         def _one_target(target):
@@ -2815,7 +2845,17 @@ class VariantEngine:
                     budget = (
                         getattr(eng, "plane_hbm_budget_gb", 11.0) * 1e9
                     )
-                    if per_dev + resident > budget:
+                    from .parallel.mesh import plane_budget_verdict
+
+                    verdict = plane_budget_verdict(
+                        per_dev, resident, budget
+                    )
+                    # kept for the life of the stack: every later
+                    # selected-samples query that has to take the
+                    # planeless road cites this measured headroom as
+                    # the reason the mesh leg wasn't taken
+                    self._plane_budget_verdict = verdict
+                    if not verdict["fits"]:
                         with_planes = False
                 stacked = StackedIndex(
                     shards,
@@ -2867,6 +2907,19 @@ class VariantEngine:
             and stacked.has_planes
             and device_ref_ok
         )
+        if payload.selected_samples_only and not stacked.has_planes:
+            # the alternative not taken: the one-pjit selected-samples
+            # leaf exists but the build-time budget gate declined to
+            # stack the planes — cite the measured shortfall
+            v = getattr(self, "_plane_budget_verdict", None) or {}
+            if v.get("fits") is False:
+                plan_stage(
+                    "mesh",
+                    decision="planes_declined",
+                    reason="planes_budget",
+                    headroom_bytes=v.get("headroomBytes"),
+                    per_device_bytes=v.get("perDeviceBytes"),
+                )
         sel_idx_of: dict = {}
         if selected_mesh:
             from .ops.plane_kernel import sample_mask_words

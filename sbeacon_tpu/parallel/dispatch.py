@@ -63,6 +63,8 @@ from ..payloads import (
     VariantQueryPayload,
     VariantSearchResponse,
 )
+from .. import telemetry as telemetry_mod
+from ..plan import plan_stage
 from ..resilience import (
     CLOSED,
     OPEN,
@@ -566,6 +568,14 @@ def ops_digest(engine, extras: dict | None = None) -> dict:
             else 0
         ),
         "openBreakers": breakers,
+        # device-health exchange fields (module attr, not a from-import:
+        # the recorder is process-global and tests swap it): a replica
+        # quietly recompiling mid-request or padding most of its lanes
+        # away shows up in the FLEET view, not just its own /debug
+        "midRequestCompiles": (
+            telemetry_mod.flight_recorder.mid_request_compiles()
+        ),
+        "worstPadWaste": telemetry_mod.flight_recorder.worst_pad_waste(),
     }
     if extras:
         doc.update(extras)
@@ -1709,7 +1719,12 @@ class MeshDispatchTier:
         if state is None:
             with self._lock:
                 built = self._state is not None
-            self._note_refusal("stale" if built else "unbuilt")
+            if built:
+                self._note_refusal("stale")
+                plan_stage("mesh", decision="refused", reason="stale")
+            else:
+                self._note_refusal("unbuilt")
+                plan_stage("mesh", decision="refused", reason="unbuilt")
             return set()
         index = state[0]
         if self._is_plane_query(payload):
@@ -1724,12 +1739,32 @@ class MeshDispatchTier:
                 ref_ok is not None and not ref_ok(payload, payload)
             ):
                 self._note_refusal("planes")
+                ledger = getattr(self.engine, "plane_ledger", None)
+                headroom = (
+                    ledger().get("headroomBytes")
+                    if callable(ledger)
+                    else None
+                )
+                plan_stage(
+                    "mesh",
+                    decision="refused",
+                    reason="planes",
+                    has_planes=bool(index.has_planes),
+                    headroom_bytes=headroom,
+                )
                 return set()
         _index, _sid_of, _shard_of, keys_by_ds, _fp = state[:5]
         covered = {ds for ds in dataset_ids if ds in keys_by_ds}
         n_targets = sum(len(keys_by_ds[ds]) for ds in covered)
         if n_targets < self.min_shards:
             self._note_refusal("min_shards")
+            plan_stage(
+                "mesh",
+                decision="refused",
+                reason="min_shards",
+                targets=n_targets,
+                min_shards=self.min_shards,
+            )
             return set()
         return covered
 
@@ -1961,6 +1996,13 @@ class MeshDispatchTier:
             mesh_delta_tail=len(delta_targets),
             mesh_planes=plane_q,
         )
+        plan_stage(
+            "mesh",
+            decision="served",
+            shards=len(targets),
+            delta_tail=len(delta_targets),
+            planes=plane_q,
+        )
         return responses
 
     def note_fallback(self) -> None:
@@ -2183,6 +2225,18 @@ class FleetView:
             for u, w in workers.items()
             if w.get("medianRttMs") is not None
         }
+        # worst-compiling replica: the digest's midRequestCompiles field
+        # (a replica silently recompiling per request burns its latency
+        # budget on XLA, not on serving — name it fleet-wide)
+        compiles = {
+            u: int((w.get("digest") or {}).get("midRequestCompiles", 0))
+            for u, w in workers.items()
+        }
+        worst_compiling = None
+        if any(compiles.values()):
+            worst_compiling = max(
+                sorted(compiles), key=lambda u: compiles[u]
+            )
         # live migrations ride the digest (ISSUE 16): phase + ages per
         # in-flight migration, and the diagnosis names a STUCK one
         # (phase age beyond the controller's stuck bound — the
@@ -2213,6 +2267,7 @@ class FleetView:
                     u for u, w in workers.items() if not w["reachable"]
                 ),
                 "stuckMigration": stuck,
+                "worstCompilingReplica": worst_compiling,
             },
         }
 
@@ -2867,6 +2922,12 @@ class DistributedEngine:
             # An open route also arms the background rediscovery loop
             # (the worker may have restarted with fresh shards).
             annotate(breaker="open")
+            plan_stage(
+                "worker",
+                decision="fast_fail",
+                reason="breaker_open",
+                worker=url,
+            )
             self._nudge_rediscovery()
             raise CircuitOpen(f"worker {url}: circuit open")
         # serialize ONCE: the pooled transport ships these bytes
@@ -3015,6 +3076,9 @@ class DistributedEngine:
         if not done and started.is_set():
             note_hedge()  # process-wide transport.hedges counter
             annotate(replica_hedge=True)
+            plan_stage(
+                "worker", decision="hedged", primary=url, hedge=other
+            )
             publish_event("dispatch.hedge", primary=url, hedge=other)
             futs[
                 pool.submit(self._call_worker, other, payload, deadline, ctx)
@@ -3094,6 +3158,13 @@ class DistributedEngine:
                 with self._sc_lock:
                     self._failovers += 1
                 annotate(failover=True)
+                plan_stage(
+                    "worker",
+                    decision="failover",
+                    failed=u,
+                    to=nu,
+                    datasets=len(nds),
+                )
                 publish_event(
                     "dispatch.failover",
                     failed=u,
@@ -3182,15 +3253,24 @@ class DistributedEngine:
             # which tier is serving this query (the slow-query log's
             # dispatch attribution)
             if mesh_ds:
-                annotate(
-                    dispatch_tier=(
-                        "mesh" if not (tasks or local_wanted) else "mixed"
-                    )
+                tier_label = (
+                    "mesh" if not (tasks or local_wanted) else "mixed"
+                )
+                annotate(dispatch_tier=tier_label)
+                plan_stage(
+                    "tier",
+                    decision=tier_label,
+                    mesh_datasets=len(mesh_ds),
+                    worker_groups=len(tasks),
                 )
             elif tasks:
                 annotate(dispatch_tier="http")
+                plan_stage(
+                    "tier", decision="http", worker_groups=len(tasks)
+                )
             elif local_wanted:
                 annotate(dispatch_tier="local")
+                plan_stage("tier", decision="local")
             # the POD-LOCAL mesh leg runs on this thread concurrently
             # with the worker scatter: one compiled launch answers the
             # whole local dataset group. A mesh failure falls back ONCE
@@ -3207,6 +3287,12 @@ class DistributedEngine:
                 except Exception as e:
                     tier.note_fallback()
                     annotate(mesh_fallback=True)
+                    plan_stage(
+                        "fallback",
+                        decision="scatter",
+                        reason="mesh_error",
+                        datasets=len(mesh_ds),
+                    )
                     publish_event(
                         "mesh.fallback",
                         datasets=len(mesh_ds),
@@ -3346,6 +3432,12 @@ class DistributedEngine:
                 with self._sc_lock:
                     self._partials += 1
                 annotate(unavailable_datasets=tuple(unavailable))
+                plan_stage(
+                    "fallback",
+                    decision="partial",
+                    reason="no_replica",
+                    datasets=len(unavailable),
+                )
                 publish_event(
                     "dispatch.partial", datasets=list(unavailable)
                 )

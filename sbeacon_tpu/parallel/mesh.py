@@ -288,6 +288,24 @@ class StackedIndex:
         }
 
 
+def plane_budget_verdict(
+    per_device_bytes: int, resident_bytes: int, budget_bytes: float
+) -> dict:
+    """The plane-budget gate's decision WITH its evidence: whether the
+    stacked planes fit next to what is already resident, and the
+    measured headroom either way. The engine stores the verdict so a
+    later refusal ("mesh declined planes") can say not just *that* the
+    road wasn't taken but *by how many bytes* it missed."""
+    budget = int(budget_bytes)
+    return {
+        "fits": per_device_bytes + resident_bytes <= budget,
+        "perDeviceBytes": int(per_device_bytes),
+        "residentBytes": int(resident_bytes),
+        "budgetBytes": budget,
+        "headroomBytes": budget - resident_bytes - per_device_bytes,
+    }
+
+
 def _local_query(arrays_local, enc, *, window_cap, record_cap, n_iters, axis):
     """Body run per device: vmap datasets × vmap queries, psum fan-in."""
 

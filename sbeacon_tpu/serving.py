@@ -49,6 +49,7 @@ from .resilience import (
     DeadlineExceeded,
     current_deadline,
 )
+from .plan import plan_stage
 from .telemetry import (
     annotate,
     charge_cost_to,
@@ -406,6 +407,11 @@ class MicroBatcher:
         annotate(
             batch_ms=round((time.perf_counter() - me.t_submit) * 1e3, 2),
             batch_index=type(dindex).__name__,
+        )
+        plan_stage(
+            "batch",
+            decision=type(dindex).__name__,
+            batch_ms=round((time.perf_counter() - me.t_submit) * 1e3, 2),
         )
         return me.result
 

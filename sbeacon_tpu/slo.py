@@ -62,6 +62,7 @@ PROBE_ROUTE_LABELS = frozenset({
     "canary",
     "ops.events",
     "ops.costs",
+    "ops.plans",
     "debug.status",
     "device.status",
     "fleet.status",

@@ -668,7 +668,10 @@ class RequestContext:
     still be annotating after the request returned. Two concurrent
     annotates may drop one note; acceptable for observability."""
 
-    __slots__ = ("trace_id", "route", "t_start", "notes", "cost")
+    __slots__ = (
+        "trace_id", "route", "t_start", "notes", "cost", "plan",
+        "explain",
+    )
 
     def __init__(self, trace_id: str | None = None, route: str = ""):
         self.trace_id = trace_id or new_trace_id()
@@ -678,6 +681,14 @@ class RequestContext:
         #: the request's resource-cost vector (ISSUE 11): created
         #: eagerly so concurrent charge sites never race an install
         self.cost = CostVector()
+        #: the request's execution-plan stage list (ISSUE 19):
+        #: plan.plan_stage appends bounded entries; created eagerly
+        #: like the cost vector so producers never race an install
+        self.plan: list = []
+        #: True when the API layer authorized ?explain=1 — the engine's
+        #: cache front bypasses the response cache for explained
+        #: requests (plan.explain_active)
+        self.explain = False
 
     def elapsed_ms(self) -> float:
         return (time.perf_counter() - self.t_start) * 1e3

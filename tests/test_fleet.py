@@ -42,6 +42,8 @@ DIGEST_KEYS = {
     "deltaTails",
     "deltaPublishes",
     "openBreakers",
+    "midRequestCompiles",
+    "worstPadWaste",
 }
 
 #: golden key set of the /fleet/status document
@@ -59,6 +61,7 @@ DIAGNOSIS_KEYS = {
     "hottestWorker",
     "divergentDatasets",
     "unreachableWorkers",
+    "worstCompilingReplica",
 }
 
 

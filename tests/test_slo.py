@@ -330,7 +330,7 @@ def test_debug_status_schema_and_diagnosis(app):
     assert set(doc) == {
         "ready", "beaconId", "slo", "breakers", "routing", "queues",
         "ingest", "stages", "costs", "canary", "device", "events",
-        "diagnosis",
+        "plans", "diagnosis",
     }
     # canary rollup (ISSUE 12): the prober exists (idle) on every app
     assert doc["canary"]["registeredProbes"] == 0
@@ -360,6 +360,7 @@ def test_debug_status_schema_and_diagnosis(app):
         "breachedSlos", "openBreakers", "slowestStage", "slowestWorker",
         "costliestTenant", "costliestShape", "canaryMismatches",
         "worstPadWaste", "midRequestCompiles", "lastMidRequestCompile",
+        "planDrift",
     }
     assert set(doc["events"]) == {"lastSeq", "published"}
     # single-host app: no worker routing section content

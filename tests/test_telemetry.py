@@ -237,6 +237,9 @@ GOLDEN_METRICS = [
     "canary.mismatches",
     "canary.failures",
     "canary.slow_probes",
+    "plan.sampled",
+    "plan.shapes",
+    "plan.drift",
     "device.launches",
     "device.evaluated_pairs",
     "device.pad_waste",

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Bench-round history differ (ISSUE 19 satellite).
+
+The repo keeps one ``BENCH_rNN.json`` per roofline round (tools/
+bench_tpu.py output: ``{n, cmd, rc, tail, parsed}``), but nothing
+compared them — a regression between rounds only surfaced if someone
+eyeballed two JSON blobs. This tool diffs consecutive parseable rounds
+per metric key and flags moves beyond a threshold in the *bad*
+direction:
+
+- rounds are ordered by their ``n`` field (filename as tie-break);
+  rounds whose ``parsed`` is null (the harness truncated the tail
+  mid-string) are listed as skipped, never a crash,
+- the parsed document flattens to dotted numeric keys: top-level
+  scalars (``value``, ``xla_qps``) and one level of config sub-dicts
+  (``config3_bracket_chr1_22.qps``),
+- direction is inferred from the key's suffix: throughput-like keys
+  (``qps``, ``value``, ``gb_per_s``, ``vs_baseline``) regress when
+  they DROP; latency-like keys (``_ms``, ``_s``, ``p50``/``p99``)
+  regress when they RISE; unrecognized keys are reported as informative
+  changes only.
+
+Exit status is 0 unless ``--strict`` is given and a regression beyond
+``--threshold`` (default 10%) was found — history inspection must not
+break a build that merely ran fewer configs this round. Stdlib-only,
+like every tools/check_* linter. Run directly or via the obs smoke
+test in tests/test_plan.py.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+
+#: suffixes whose DROP is a regression (throughput-like)
+HIGHER_IS_BETTER = (
+    "qps",
+    "value",
+    "vs_baseline",
+    "gb_per_s",
+    "queries",
+)
+#: suffixes whose RISE is a regression (latency-/time-like)
+LOWER_IS_BETTER = (
+    "_ms",
+    "_s",
+    "p50_ms",
+    "p99_ms",
+    "ms_per_batch",
+)
+
+
+def direction(key: str) -> int:
+    """+1 when higher is better, -1 when lower is better, 0 unknown.
+    The leaf name decides (``config1_single_snv.p50_ms`` -> p50_ms);
+    latency suffixes win over the generic ``_s`` in ``vs_baseline``-
+    style keys because the check runs most-specific-first."""
+    leaf = key.rsplit(".", 1)[-1]
+    for suf in HIGHER_IS_BETTER:
+        if leaf == suf or leaf.endswith("_" + suf):
+            return 1
+    for suf in LOWER_IS_BETTER:
+        if leaf.endswith(suf):
+            return -1
+    return 0
+
+
+def flatten(parsed: dict, prefix: str = "", depth: int = 3) -> dict[str, float]:
+    """Dotted numeric view of one round's parsed document, recursing
+    through the ``detail`` block into the ``configN_*`` sub-dicts
+    (``detail.config3_bracket_chr1_22.qps``). Strings (kernel names,
+    parity ratios) are identity, not series; depth is bounded so a
+    malformed round cannot recurse away."""
+    out: dict[str, float] = {}
+    for k, v in parsed.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, bool):
+            continue
+        if isinstance(v, (int, float)):
+            out[key] = float(v)
+        elif isinstance(v, dict) and depth > 1:
+            out.update(flatten(v, prefix=key + ".", depth=depth - 1))
+    return out
+
+
+def load_rounds(bench_dir: Path) -> tuple[list[tuple[str, dict]], list[str]]:
+    """([(name, parsed)] in round order, [skipped names]) over every
+    ``BENCH_*.json`` under ``bench_dir``."""
+    rounds: list[tuple[int, str, dict]] = []
+    skipped: list[str] = []
+    for path in sorted(bench_dir.glob("BENCH_*.json")):
+        try:
+            doc = json.loads(path.read_text())
+        except (OSError, ValueError):
+            skipped.append(path.name)
+            continue
+        # two shapes exist: the harness wrapper {n, cmd, rc, tail,
+        # parsed} and a bare parsed document (BENCH_r05_builder.json)
+        parsed = doc.get("parsed", doc if "metric" in doc else None)
+        if not isinstance(parsed, dict):
+            skipped.append(path.name)
+            continue
+        n = doc.get("n")
+        rounds.append(
+            (n if isinstance(n, int) else 1 << 30, path.name, parsed)
+        )
+    rounds.sort(key=lambda r: (r[0], r[1]))
+    return [(name, parsed) for _n, name, parsed in rounds], skipped
+
+
+def diff_rounds(
+    rounds: list[tuple[str, dict]], threshold: float
+) -> tuple[list[dict], list[dict]]:
+    """(regressions, changes) between each consecutive round pair, per
+    shared flattened key. A move beyond ``threshold`` (fractional)
+    against the key's good direction is a regression; every move
+    beyond threshold is a change."""
+    regressions: list[dict] = []
+    changes: list[dict] = []
+    for (name_a, a), (name_b, b) in zip(rounds, rounds[1:]):
+        fa, fb = flatten(a), flatten(b)
+        for key in sorted(set(fa) & set(fb)):
+            va, vb = fa[key], fb[key]
+            if va == 0:
+                continue
+            delta = (vb - va) / abs(va)
+            if abs(delta) < threshold:
+                continue
+            rec = {
+                "key": key,
+                "from": name_a,
+                "to": name_b,
+                "before": va,
+                "after": vb,
+                "deltaPct": round(delta * 100, 1),
+            }
+            changes.append(rec)
+            d = direction(key)
+            if (d > 0 and delta < 0) or (d < 0 and delta > 0):
+                regressions.append(rec)
+    return regressions, changes
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument(
+        "--dir", type=Path, default=REPO, help="directory of BENCH_*.json"
+    )
+    ap.add_argument(
+        "--threshold",
+        type=float,
+        default=0.10,
+        help="fractional change that counts as a move (default 0.10)",
+    )
+    ap.add_argument(
+        "--strict",
+        action="store_true",
+        help="exit 1 when a regression beyond the threshold was found",
+    )
+    args = ap.parse_args(argv)
+    rounds, skipped = load_rounds(args.dir)
+    for name in skipped:
+        print(f"skipped (unparseable): {name}")
+    if len(rounds) < 2:
+        print(f"{len(rounds)} parseable round(s): nothing to diff")
+        return 0
+    regressions, changes = diff_rounds(rounds, args.threshold)
+    for rec in changes:
+        mark = "REGRESSION" if rec in regressions else "change"
+        print(
+            f"{mark}: {rec['key']} {rec['before']:g} -> "
+            f"{rec['after']:g} ({rec['deltaPct']:+.1f}%) "
+            f"[{rec['from']} -> {rec['to']}]"
+        )
+    print(
+        f"{len(rounds)} rounds, {len(changes)} moves beyond "
+        f"{args.threshold:.0%}, {len(regressions)} regression(s)"
+    )
+    if regressions and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

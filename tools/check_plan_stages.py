@@ -1,0 +1,207 @@
+#!/usr/bin/env python
+"""Static plan-stage lint for the execution-plan plane (ISSUE 19).
+
+``plan.plan_stage(...)`` stages and refusal reasons are the plan
+document's schema: ``/ops/plans`` aggregates key on the plan-shape
+fingerprint built from them, and the drift sentinel compares those
+fingerprints across windows — so the vocabulary must be as auditable
+as metric names. This tool is the twin of ``check_annotation_keys.py``
+for the plan surface:
+
+- every ``plan_stage(...)`` call anywhere under ``sbeacon_tpu/`` must
+  pass its stage as a LITERAL string registered in ``plan.PLAN_STAGES``
+  (an unregistered stage is an invisible decision),
+- every ``reason=`` keyword must be a literal member of
+  ``plan.PLAN_REASONS`` (a refusal reason nobody can grep for is a
+  refusal nobody will diagnose); a computed stage or reason is
+  rejected outright,
+- every registered stage AND reason must be USED by at least one call
+  site (a registered-but-unused entry is schema drift) — two-way
+  parity, like the metric catalogue.
+
+``decision=`` and detail keywords stay free-form: the decision is the
+branch taken (often a runtime label like the launch family) and the
+details carry measured evidence — neither is registry vocabulary.
+
+The registries are read from ``plan.py`` by AST (no package import —
+the lint must run in a bare interpreter). Run directly
+(``python tools/check_plan_stages.py``) or via the tier-1 test
+``tests/test_plan.py::test_plan_stage_lint``.
+"""
+
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+PLAN = PKG / "plan.py"
+
+
+def registry(name: str, path: Path = PLAN) -> set[str] | None:
+    """The literal frozenset assigned to ``name`` in plan.py, or None
+    when the assignment is missing/non-literal (itself a lint
+    failure)."""
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return None
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == name
+            for t in node.targets
+        ):
+            continue
+        try:
+            value = ast.literal_eval(node.value)
+        except ValueError:
+            # frozenset({...}) is a Call, not a literal — evaluate its
+            # single literal argument instead
+            call = node.value
+            if (
+                isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Name)
+                and call.func.id == "frozenset"
+                and len(call.args) == 1
+            ):
+                try:
+                    value = ast.literal_eval(call.args[0])
+                except ValueError:
+                    return None
+            else:
+                return None
+        return {str(v) for v in value}
+    return None
+
+
+def _literal_str(node) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def scan(
+    root: Path = PKG,
+) -> tuple[dict[str, list[str]], dict[str, list[str]], list[str]]:
+    """({stage: [sites]}, {reason: [sites]}, [errors]) over every
+    ``plan_stage(...)`` call under ``root`` (calls of a bare name or
+    attribute named ``plan_stage``)."""
+    stages: dict[str, list[str]] = {}
+    reasons: dict[str, list[str]] = {}
+    errors: list[str] = []
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root.parent)
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError as e:  # pragma: no cover - broken tree
+            errors.append(f"{rel}: unparseable ({e})")
+            continue
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            name = (
+                fn.id
+                if isinstance(fn, ast.Name)
+                else fn.attr if isinstance(fn, ast.Attribute) else None
+            )
+            if name != "plan_stage":
+                continue
+            where = f"{rel}:{node.lineno}"
+            if len(node.args) != 1:
+                errors.append(
+                    f"{where}: plan_stage() takes exactly one "
+                    "positional arg (the stage); decisions and details "
+                    "are keywords"
+                )
+            else:
+                stage = _literal_str(node.args[0])
+                if stage is None:
+                    errors.append(
+                        f"{where}: plan_stage stage must be a literal "
+                        "string so it can be audited"
+                    )
+                else:
+                    stages.setdefault(stage, []).append(where)
+            for kw in node.keywords:
+                if kw.arg is None:
+                    errors.append(
+                        f"{where}: plan_stage(**dynamic) — stage "
+                        "entries must be literal keywords so they can "
+                        "be audited"
+                    )
+                    continue
+                if kw.arg == "reason":
+                    reason = _literal_str(kw.value)
+                    if reason is None:
+                        errors.append(
+                            f"{where}: plan_stage reason= must be a "
+                            "literal string (restructure the branch "
+                            "instead of computing the reason)"
+                        )
+                    else:
+                        reasons.setdefault(reason, []).append(where)
+    return stages, reasons, errors
+
+
+def lint(
+    stages: dict[str, list[str]],
+    reasons: dict[str, list[str]],
+    stage_registry: set[str] | None,
+    reason_registry: set[str] | None,
+) -> list[str]:
+    errors = []
+    for name, reg, used in (
+        ("PLAN_STAGES", stage_registry, stages),
+        ("PLAN_REASONS", reason_registry, reasons),
+    ):
+        if reg is None:
+            errors.append(
+                f"plan.py: {name} literal frozenset not found — the "
+                "registry must be a plain literal so this lint can "
+                "parse it"
+            )
+            continue
+        kind = "stage" if name == "PLAN_STAGES" else "reason"
+        for key in sorted(set(used) - reg):
+            sites = ", ".join(used[key][:3])
+            errors.append(
+                f"plan {kind} {key!r} (used at {sites}) is not in "
+                f"plan.{name} — register it or fix the typo"
+            )
+        for key in sorted(reg - set(used)):
+            errors.append(
+                f"plan.{name} documents {key!r} but no plan_stage() "
+                "call site records it — drop it or it is drift"
+            )
+    if not stages:
+        errors.append(
+            "no plan_stage() call sites found under sbeacon_tpu/ — "
+            "either the plan plane was removed or this tool's scan "
+            "drifted from the idiom"
+        )
+    return errors
+
+
+def main() -> int:
+    stages, reasons, errors = scan()
+    errors += lint(
+        stages, reasons, registry("PLAN_STAGES"), registry("PLAN_REASONS")
+    )
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}")
+        return 1
+    print(
+        f"ok: {sum(len(v) for v in stages.values())} plan_stage() "
+        f"sites, {len(stages)} stages, {len(reasons)} reasons, "
+        "registries in sync"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
