@@ -3778,6 +3778,345 @@ def config21_roofline(c2_detail: dict | None = None):
     return out
 
 
+def config22_wirespeed():
+    """Wire-speed ingest at fleet scale (ISSUE 20): three probes.
+
+    (a) Remote scan soak — a bgzipped VCF served over ranged HTTP is
+    slice-scanned through the native path (ranged GET + in-place
+    buffer inflate through the codec seam, then the native tokenizer)
+    vs the pure-Python fallback path (the byte-identical
+    parse_record + build_index plane every blob degrades to), at
+    1 / 2 / 4 scan workers. The claim: native throughput >= 2x
+    pure-Python at >= 2 workers — the python leg serialises record
+    parsing on the interpreter while the native leg's sockets and
+    inflate both release the GIL. A third leg (``BEACON_NATIVE_IO=0``
+    with the native tokenizer kept) isolates the decode seam's own
+    contribution and is recorded as informative.
+
+    (b) Per-key L0 isolation — three datasets with standing delta
+    tails; a publish burst on ONE key must rebuild only that key's L0
+    block (untouched keys' blocks reused by object identity), keep
+    serving p99 within 2x the pre-burst idle, and pay zero
+    mid-request compiles.
+
+    (c) Churn soak under the tiered DEFAULT (compact_base_ratio 0.35
+    out of the box): repeated delta waves + compactor sweeps must show
+    L1 adoption (tier_folds), a bounded standing tail, and stable GC
+    reclaim."""
+    import os as _os
+    import random as _random
+    import tempfile
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+    from pathlib import Path
+
+    import numpy as _np
+
+    import sbeacon_tpu.telemetry as _tel
+    from sbeacon_tpu.config import (
+        BeaconConfig,
+        EngineConfig,
+        IngestConfig,
+        StorageConfig,
+    )
+    from sbeacon_tpu.engine import VariantEngine
+    from sbeacon_tpu.genomics.vcf import write_vcf
+    from sbeacon_tpu.genomics.tabix import ensure_index
+    from sbeacon_tpu.index.columnar import build_index
+    from sbeacon_tpu.ingest import pipeline as _pl
+    from sbeacon_tpu.ingest.ledger import JobLedger
+    from sbeacon_tpu.ingest.pipeline import SummarisationPipeline
+    from sbeacon_tpu.ingest.planner import plan_slices
+    from sbeacon_tpu.ingest.service import DeltaCompactor
+    from sbeacon_tpu.payloads import VariantQueryPayload
+    from sbeacon_tpu.testing import random_records, range_server
+
+    out: dict = {}
+    rng = _random.Random(2200)
+    samples = ["S0", "S1"]
+
+    # -- (a) remote scan soak: native path vs pure-Python fallback ----
+    from sbeacon_tpu import native as _nat
+
+    with tempfile.TemporaryDirectory(prefix="bench-wire-") as td:
+        root = Path(td)
+        vcf = root / "wire.vcf.gz"
+        recs = random_records(rng, chrom="7", n=40000, n_samples=2)
+        write_vcf(vcf, recs, sample_names=samples)
+        idx = ensure_index(vcf)
+        slices = plan_slices(
+            idx,
+            IngestConfig(
+                min_task_time=1e-9,
+                scan_rate=1e4,
+                dispatch_cost=1e-10,
+                max_concurrency=64,
+            ),
+        ).slices
+        comp_bytes = vcf.stat().st_size
+
+        def soak(url: str, workers: int) -> dict:
+            t0 = time.perf_counter()
+            with ThreadPoolExecutor(max_workers=workers) as ex:
+                shards = list(
+                    ex.map(
+                        lambda sl: _pl.scan_slice_to_shard(
+                            url,
+                            sl[0],
+                            sl[1],
+                            dataset_id="wire",
+                            sample_names=samples,
+                        ),
+                        slices,
+                    )
+                )
+            dt = time.perf_counter() - t0
+            return {
+                "seconds": round(dt, 3),
+                "rows": int(sum(s.n_rows for s in shards)),
+                "compressed_mb_per_s": round(
+                    comp_bytes / dt / 2**20, 2
+                ),
+            }
+
+        fallbacks0 = _pl.NATIVE_FALLBACKS.count()
+        scan_legs: dict = {"n_slices": len(slices)}
+        orig_available = _nat.available
+        with range_server(root) as base:
+            url = f"{base}/wire.vcf.gz"
+            for workers in (1, 2, 4):
+                # pure-Python fallback plane: the library "absent"
+                _nat.available = lambda: False
+                try:
+                    py = soak(url, workers)
+                finally:
+                    _nat.available = orig_available
+                # decode seam off, native tokenizer kept (informative)
+                _os.environ["BEACON_NATIVE_IO"] = "0"
+                try:
+                    py_decode = soak(url, workers)
+                finally:
+                    _os.environ.pop("BEACON_NATIVE_IO", None)
+                nat = soak(url, workers)
+                scan_legs[f"w{workers}"] = {
+                    "python": py,
+                    "python_decode_native_tokenizer": py_decode,
+                    "native": nat,
+                    "native_speedup": round(
+                        py["seconds"] / max(nat["seconds"], 1e-9), 2
+                    ),
+                }
+        scan_legs["native_fallbacks_during_soak"] = (
+            _pl.NATIVE_FALLBACKS.count() - fallbacks0
+        )
+        scan_legs["native_2x_at_2_workers"] = bool(
+            scan_legs["w2"]["native_speedup"] >= 2.0
+        )
+        scan_legs["native_2x_at_4_workers"] = bool(
+            scan_legs["w4"]["native_speedup"] >= 2.0
+        )
+        out["remote_scan"] = scan_legs
+
+    # -- (b) per-key L0 isolation under a single-key burst ------------
+    datasets = ["wireA", "wireB", "wireC"]
+    eng = VariantEngine(
+        BeaconConfig(
+            engine=EngineConfig(
+                use_mesh=False,
+                response_cache=False,
+                l0_min_shards=3,
+                l0_min_rows=0,
+            )
+        )
+    )
+    base_sets = {}
+    for di, ds in enumerate(datasets):
+        base_sets[ds] = random_records(
+            rng, chrom=str(di + 1), n=3000, n_samples=2
+        )
+        eng.add_index(
+            build_index(
+                base_sets[ds],
+                dataset_id=ds,
+                vcf_location=f"{ds}.vcf",
+                sample_names=samples,
+            )
+        )
+    eng.warmup()
+    tail_sets = {
+        ds: random_records(rng, chrom=str(di + 1), n=800, n_samples=2)
+        for di, ds in enumerate(datasets)
+    }
+    for ds in datasets:
+        step = len(tail_sets[ds]) // 4
+        for i in range(4):
+            hi = (i + 1) * step if i < 3 else len(tail_sets[ds])
+            eng.add_delta(
+                build_index(
+                    tail_sets[ds][i * step:hi],
+                    dataset_id=ds,
+                    vcf_location=f"{ds}.vcf",
+                    sample_names=samples,
+                )
+            )
+
+    def _q22(k: int, chrom: str) -> VariantQueryPayload:
+        lo = 1 + 89 * (k % 64)
+        return VariantQueryPayload(
+            dataset_ids=[],
+            reference_name=chrom,
+            start_min=lo,
+            start_max=lo + (1 << 27),
+            end_min=lo,
+            end_max=lo + (1 << 27) + 64,
+            alternate_bases="N",
+            requested_granularity="count",
+            include_datasets="HIT",
+        )
+
+    def _p99(chrom: str, n: int = 128) -> dict:
+        lat = []
+        for k in range(n):
+            t0 = time.perf_counter()
+            eng.search(_q22(k, chrom))
+            lat.append((time.perf_counter() - t0) * 1e3)
+        a = _np.asarray(lat)
+        return {
+            "p50_ms": round(float(_np.percentile(a, 50)), 3),
+            "p99_ms": round(float(_np.percentile(a, 99)), 3),
+        }
+
+    idle = _p99("2")  # wireB's rows: the untouched key's serving path
+    status0 = eng.l0_status()
+    builds0 = {
+        k: v["builds"] for k, v in status0.get("keys", {}).items()
+    }
+    b_block0 = eng._l0_blocks.get(("wireB", "wireB.vcf"), (None,))[0]
+    mid0 = _tel.flight_recorder.mid_request_compiles()
+    burst_lat: list = []
+    for i in range(8):
+        eng.add_delta(
+            build_index(
+                random_records(rng, chrom="1", n=40, n_samples=2),
+                dataset_id="wireA",
+                vcf_location="wireA.vcf",
+                sample_names=samples,
+            )
+        )
+        t0 = time.perf_counter()
+        eng.search(_q22(i, "2"))
+        burst_lat.append((time.perf_counter() - t0) * 1e3)
+    during = _p99("2")
+    status1 = eng.l0_status()
+    builds1 = {
+        k: v["builds"] for k, v in status1.get("keys", {}).items()
+    }
+    b_block1 = eng._l0_blocks.get(("wireB", "wireB.vcf"), (None,))[0]
+    ratio = during["p99_ms"] / max(idle["p99_ms"], 1e-6)
+    out["per_key_l0"] = {
+        "idle": idle,
+        "during_burst": during,
+        "burst_probe_p99_ms": round(
+            float(_np.percentile(_np.asarray(burst_lat), 99)), 3
+        ),
+        "builds_before": builds0,
+        "builds_after": builds1,
+        "touched_key_rebuilt": bool(
+            builds1.get("wireA/wireA.vcf", 0)
+            > builds0.get("wireA/wireA.vcf", 0)
+        ),
+        "untouched_keys_not_restacked": bool(
+            builds1.get("wireB/wireB.vcf")
+            == builds0.get("wireB/wireB.vcf")
+            and builds1.get("wireC/wireC.vcf")
+            == builds0.get("wireC/wireC.vcf")
+        ),
+        "untouched_block_identity_preserved": bool(
+            b_block0 is not None and b_block1 is b_block0
+        ),
+        "block_reuses": status1.get("blockReuses", 0),
+        "mid_request_compiles_during_burst": (
+            _tel.flight_recorder.mid_request_compiles() - mid0
+        ),
+        "zero_mid_request_compiles": bool(
+            _tel.flight_recorder.mid_request_compiles() - mid0 == 0
+        ),
+        "p99_burst_vs_idle": round(ratio, 2),
+        "p99_within_2x_idle_or_25ms": bool(
+            during["p99_ms"] <= max(2.0 * idle["p99_ms"], 25.0)
+        ),
+    }
+
+    # -- (c) churn soak under the tiered DEFAULT ----------------------
+    with tempfile.TemporaryDirectory(prefix="bench-churn-") as td:
+        cfg = BeaconConfig(
+            storage=StorageConfig(root=Path(td) / "store"),
+            # IngestConfig() defaults: compact_base_ratio 0.35 — the
+            # soak runs what ships, only the sweep cadence is manual
+            ingest=IngestConfig(
+                compact_interval_s=0.0, artifact_retain=0
+            ),
+        )
+        assert cfg.ingest.compact_base_ratio == 0.35, "tiered default"
+        cfg.storage.ensure()
+        pipe = SummarisationPipeline(cfg, ledger=JobLedger(), engine=eng)
+        comp = DeltaCompactor(eng, pipe, pipe.ledger, cfg)
+        errors: list = []
+        stop = threading.Event()
+
+        def querier():
+            k = 0
+            while not stop.is_set():
+                try:
+                    eng.search(_q22(k, "2"))
+                except Exception as e:  # noqa: BLE001
+                    errors.append(repr(e))
+                    return
+                k += 1
+                time.sleep(0.002)
+
+        qt = threading.Thread(target=querier, daemon=True)
+        qt.start()
+        tail_depths = []
+        try:
+            for wave in range(4):
+                for i in range(6):
+                    eng.add_delta(
+                        build_index(
+                            random_records(
+                                rng, chrom="1", n=120, n_samples=2
+                            ),
+                            dataset_id="wireA",
+                            vcf_location="wireA.vcf",
+                            sample_names=samples,
+                        )
+                    )
+                comp.run_once()
+                tail_depths.append(
+                    eng.delta_stats()
+                    .get("wireA", {})
+                    .get("shards", 0)
+                )
+        finally:
+            stop.set()
+            qt.join(timeout=10)
+        m = comp.metrics()
+        out["churn_soak"] = {
+            "waves": 4,
+            "deltas_per_wave": 6,
+            "tail_depth_after_each_sweep": tail_depths,
+            "tail_bounded": bool(max(tail_depths) <= 1),
+            "tier_folds": m["tier_folds"],
+            "l1_adopted": bool(m["tier_folds"].get("l1", 0) >= 3),
+            "write_amplification": m["write_amplification"],
+            "gc_bytes": m["gc_bytes"],
+            "query_errors": errors,
+            "zero_query_errors": not errors,
+        }
+    eng.close()
+    return out
+
+
 def main() -> None:
     detail: dict = {"budget_s": BUDGET_S}
     headline = {"qps": 0.0}
@@ -3917,6 +4256,7 @@ def main() -> None:
     run("config18_device", 40, config18_device)
     run("config19_lsm", 60, config19_lsm)
     run("config20_migrate", 45, config20_migrate)
+    run("config22_wirespeed", 90, config22_wirespeed)
     run(
         "config21_roofline",
         90,

@@ -253,9 +253,13 @@ class IngestConfig:
     # (persisted, epoch-ranged, adoptable after a crash) and the full
     # base merge only runs once the accumulated L1 bytes reach this
     # ratio of the base's bytes, so per-fold write amplification stops
-    # scaling with base size. <=0 keeps the legacy policy: every fold
-    # is a full base merge.
-    compact_base_ratio: float = 0.0
+    # scaling with base size. <=0 selects the legacy policy: every
+    # fold is a full base merge. Tiered is the DEFAULT since ISSUE 20
+    # (the config22 churn soak in BENCH_wirespeed: sustained multi-key
+    # ingest folds L1 per trigger, base merges only at the ratio, GC
+    # stays bounded); set BEACON_COMPACT_BASE_RATIO=0 to get the
+    # legacy merge-every-fold behaviour back.
+    compact_base_ratio: float = 0.35
     # superseded base/L1 artifacts are parked in a per-key .retired/
     # dir at each base merge and the newest N generations are kept;
     # older ones are GC'd (ingest.gc_bytes counts the reclaim). GC

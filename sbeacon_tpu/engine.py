@@ -45,6 +45,8 @@ from .response_cache import (
     response_cache_scope,
 )
 from .telemetry import (
+    DEFAULT_MAX_LABEL_VALUES,
+    OVERFLOW_LABEL,
     annotate,
     charge_cost,
     current_context,
@@ -676,6 +678,23 @@ def register_delta_metrics(registry, supplier) -> None:
         "launch instead of per-shard host scans",
         fn=field("l0_served"),
     )
+    # per-key build attribution (ISSUE 20): the engine bounds its own
+    # key set at DEFAULT_MAX_LABEL_VALUES (overflow collapses to the
+    # sentinel), so the fn-backed series honours the cardinality cap
+    # without the registry guard
+    registry.counter(
+        "ingest.l0_key_builds",
+        "per-(dataset/vcf) L0 block stacks — a publish to one key "
+        "rebuilds only that key's block",
+        label="key",
+        fn=field("l0_key_builds"),
+    )
+    registry.counter(
+        "ingest.l0_block_reuses",
+        "standing L0 blocks reused as-is by a composite rebuild "
+        "(untouched keys are never restacked)",
+        fn=field("l0_block_reuses"),
+    )
 
 
 class VariantEngine:
@@ -799,10 +818,28 @@ class VariantEngine:
         # doubled or missing. State tuple:
         # (findex, {serve_key: sid}, {serve_key: shard}, rows, built_at)
         self._l0_state: tuple | None = None
+        # per-(dataset, vcf) L0 blocks (ISSUE 20): each covered key
+        # keeps its own standing L0DeviceIndex, rebuilt ONLY when that
+        # key's tail changes; the published _l0_state composite
+        # (ops.kernel.CompositeL0DeviceIndex) assembles the standing
+        # blocks device-side, so a publish to key A never re-stacks
+        # key B's columns. Copy-on-write under _mesh_lock like the
+        # delta registry. Value: (block_findex, [(serve_key, shard),
+        # ...], built_at).
+        self._l0_blocks: dict[tuple[str, str], tuple] = {}
         # publish generation for L0 builds (same role as _fused_gen):
         # a build whose inputs predate ANY delta/base publish must not
         # publish over fresher state
         self._l0_gen = 0
+        # per-key L0 generations: a publish to key B racing a rebuild
+        # bumps ONLY B's generation, so the rebuild still adopts the
+        # fresh per-key blocks whose inputs did not move (their stack
+        # work is never thrown away with the raced composite)
+        self._l0_key_gens: dict[tuple[str, str], int] = {}
+        # per-key L0 block build counts (label-capped telemetry +
+        # the bench's structural untouched-keys-not-restacked assert)
+        self._l0_key_builds: dict[str, int] = {}
+        self.l0_block_reuses = 0
         # L0 program shapes already warmed: the shard-tier/row padding
         # keeps successive builds on one shape, so warmup runs once
         # per shape — and covers the FULL batch-tier ladder (incl. the
@@ -971,7 +1008,7 @@ class VariantEngine:
             # the L0 coverage map change together, so a query can
             # never pair the new base with tail rows the fold already
             # absorbed (doubled) or find neither (missing)
-            self._l0_gen += 1
+            self._l0_touch_key_locked(key)
             self._retire_l0_key_locked(key)
             self._rebuild_serving_state_locked()
             self._plane_reserved.pop(
@@ -1072,7 +1109,7 @@ class VariantEngine:
             deltas = dict(self._deltas)
             deltas[key] = tail
             self._deltas = deltas
-            self._l0_gen += 1
+            self._l0_touch_key_locked(key)
             self._rebuild_serving_state_locked()
             self.delta_publishes += 1
         self._invalidate_cache(key[0], regions)
@@ -1146,7 +1183,7 @@ class VariantEngine:
             deltas = dict(self._deltas)
             deltas[key] = new_tail
             self._deltas = deltas
-            self._l0_gen += 1
+            self._l0_touch_key_locked(key)
             self._retire_l0_key_locked(key)
             self._rebuild_serving_state_locked()
         # the merged artifact serves the same ROWS the replaced deltas
@@ -1189,6 +1226,8 @@ class VariantEngine:
             "shards": sum(len(t) for t in deltas.values()),
             "l0_builds": self.l0_builds,
             "l0_served": self.l0_searches,
+            "l0_key_builds": dict(self._l0_key_builds),
+            "l0_block_reuses": self.l0_block_reuses,
         }
 
     # -- live shard migration (ISSUE 16) ------------------------------------
@@ -1290,7 +1329,7 @@ class VariantEngine:
             self._deltas = deltas
             if epoch > self._delta_seq.get(key, 0):
                 self._delta_seq[key] = epoch
-            self._l0_gen += 1
+            self._l0_touch_key_locked(key)
             self._rebuild_serving_state_locked()
             self.delta_publishes += 1
         self._invalidate_cache(key[0], regions)
@@ -1334,11 +1373,11 @@ class VariantEngine:
                 self._deltas = deltas
             for k in set(base_keys) | set(delta_keys):
                 self._delta_seq.pop(k, None)
+                self._l0_touch_key_locked(k)
                 self._retire_l0_key_locked(k)
             self._mesh_dirty = True
             self._fused_dirty = True
             self._fused_gen += 1
-            self._l0_gen += 1
             self._rebuild_serving_state_locked()
         self._invalidate_cache(dataset_id, None)
         publish_event(
@@ -1371,6 +1410,16 @@ class VariantEngine:
                 out.append(key)
         return out
 
+    def _l0_touch_key_locked(self, key) -> None:
+        """Record that ``key``'s tail moved (held under ``_mesh_lock``):
+        bumps the global L0 generation (a racing composite publish must
+        lose) AND the key's own generation, so a rebuild racing a
+        publish to a DIFFERENT key still adopts the per-key blocks
+        whose inputs did not move — only the raced composite is
+        discarded, never the untouched keys' stack work."""
+        self._l0_gen += 1
+        self._l0_key_gens[key] = self._l0_key_gens.get(key, 0) + 1
+
     def _retire_l0_key_locked(self, key) -> None:
         """Drop one key's entries from the L0 coverage map (held under
         ``_mesh_lock``): its epochs were folded into a base, replaced
@@ -1378,6 +1427,13 @@ class VariantEngine:
         arrays may keep dead rows until the next build — harmless,
         nothing routes to them — but coverage and the serve list must
         change in the same critical section."""
+        if key in self._l0_blocks:
+            # the standing per-key block covered epochs that no longer
+            # serve; drop it copy-on-write so the next rebuild restacks
+            # this key (and ONLY this key) from the live tail
+            blocks = dict(self._l0_blocks)
+            blocks.pop(key, None)
+            self._l0_blocks = blocks
         state = self._l0_state
         if state is None:
             return
@@ -1409,41 +1465,91 @@ class VariantEngine:
         and the next trigger rebuilds). Runs on the PUBLISHING thread
         — delta publication is ingest-side, never a request thread —
         and pre-warms the batch-tier programs inside a warmup phase so
-        the first request launch is a compile-cache hit."""
+        the first request launch is a compile-cache hit.
+
+        Per-key slicing (ISSUE 20): the stack is sharded by
+        (dataset, vcf) — each covered key keeps a standing
+        :class:`~.ops.kernel.L0DeviceIndex` block, and a publish to
+        key A restacks ONLY key A's block; the published index is a
+        :class:`~.ops.kernel.CompositeL0DeviceIndex` assembling the
+        standing blocks with a cheap device-side concat. Build work is
+        therefore proportional to the TOUCHED key's tail, not the sum
+        of all covered tails."""
         with self._mesh_lock:
             gen = self._l0_gen
+            key_gens = dict(self._l0_key_gens)
             deltas = self._deltas
+            blocks = self._l0_blocks
         keys = self._l0_covered_keys(deltas)
         if not keys:
             with self._mesh_lock:
                 if self._l0_gen == gen:
                     self._l0_state = None
+                    self._l0_blocks = {}
             return
-        entries = []  # (serve_key, shard) in serve-list order
+        # resolve each covered key to a standing block (reused when
+        # the key's entry list is identity-equal) or a fresh stack
+        fresh: dict = {}  # key -> (block, entries, built_at)
+        per_key: dict = {}
+        reused = 0
         for key in keys:
             ds, vcf = key
-            for epoch, shard in sorted(deltas[key].items()):
-                entries.append(((ds, f"{vcf}#d{epoch}"), shard))
+            entries = [
+                ((ds, f"{vcf}#d{epoch}"), shard)
+                for epoch, shard in sorted(deltas[key].items())
+            ]
+            standing = blocks.get(key)
+            if standing is not None:
+                _b, old_entries, _t = standing
+                if len(old_entries) == len(entries) and all(
+                    a[0] == b[0] and a[1] is b[1]
+                    for a, b in zip(old_entries, entries)
+                ):
+                    per_key[key] = standing
+                    reused += 1
+                    continue
+            try:
+                from .ops.kernel import L0DeviceIndex
+
+                block = L0DeviceIndex([s for _k, s in entries])
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "L0 block build failed; the tail host-scans"
+                )
+                return
+            standing = (block, entries, time.time())
+            per_key[key] = standing
+            fresh[key] = standing
         state = self._l0_state
-        if state is not None:
+        if not fresh and state is not None:
+            all_entries = [
+                e for key in keys for e in per_key[key][1]
+            ]
             sid_of, shard_of = state[1], state[2]
-            if len(sid_of) == len(entries) and all(
-                shard_of.get(k) is s for k, s in entries
+            if len(sid_of) == len(all_entries) and all(
+                shard_of.get(k) is s for k, s in all_entries
             ):
                 # coverage identical (e.g. a sub-threshold key
-                # published): restacking every covered tail per
-                # unrelated publish would grow quadratically in
-                # publish count for nothing
+                # published) AND every block standing: nothing to
+                # stack, nothing to compose
                 return
         try:
-            from .ops.kernel import L0DeviceIndex
+            from .ops.kernel import CompositeL0DeviceIndex
 
-            findex = L0DeviceIndex([s for _k, s in entries])
+            findex = CompositeL0DeviceIndex(
+                [per_key[k][0] for k in keys]
+            )
         except Exception:
             logging.getLogger(__name__).exception(
-                "L0 mini-index build failed; the tail host-scans"
+                "L0 composite assembly failed; the tail host-scans"
             )
             return
+        sid_of = {}
+        shard_of = {}
+        for key, off in zip(keys, findex.block_sid_offsets):
+            for j, (serve_key, shard) in enumerate(per_key[key][1]):
+                sid_of[serve_key] = off + j
+                shard_of[serve_key] = shard
         # warm BEFORE publishing: a request arriving between publish
         # and warm would dispatch a novel (program, shape) uncompiled
         # — a mid-request XLA compile on the serving path, the exact
@@ -1454,23 +1560,55 @@ class VariantEngine:
         self._l0_warm(findex)
         state = (
             findex,
-            {k: i for i, (k, _s) in enumerate(entries)},
-            dict(entries),
+            sid_of,
+            shard_of,
             int(findex.n_rows),
             time.time(),
         )
         with self._mesh_lock:
+            # adopt fresh blocks whose OWN key did not move — a publish
+            # to key B racing this build must not discard key A's stack
+            # work (the composite below may still lose on the global
+            # generation; the adopted blocks make the NEXT build cheap)
+            adoptable = {
+                k: v
+                for k, v in fresh.items()
+                if self._l0_key_gens.get(k, 0) == key_gens.get(k, 0)
+            }
+            if adoptable:
+                nb = dict(self._l0_blocks)
+                nb.update(adoptable)
+                self._l0_blocks = nb
+                for k in adoptable:
+                    self._l0_count_key_build_locked(k)
             if self._l0_gen != gen:
                 return  # a publish raced the build; rebuilt on the
                 # next trigger against the fresher tail
             self._l0_state = state
             self.l0_builds += 1
+            self.l0_block_reuses += reused
         publish_event(
             "ingest.l0_build",
             keys=len(keys),
-            shards=len(entries),
+            shards=len(sid_of),
             rows=int(findex.n_rows),
+            rebuilt=len(fresh),
+            reused=reused,
         )
+
+    def _l0_count_key_build_locked(self, key) -> None:
+        """Attribute one block stack to its ``dataset/vcf`` label,
+        bounding the label set at the registry's cardinality cap (the
+        fn-backed ``ingest.l0_key_builds`` series is guard-exempt, so
+        the producer owns the bound: past the cap, new keys collapse
+        into the overflow sentinel)."""
+        label = f"{key[0]}/{key[1]}"
+        builds = self._l0_key_builds
+        if label not in builds and (
+            len(builds) >= DEFAULT_MAX_LABEL_VALUES
+        ):
+            label = OVERFLOW_LABEL
+        builds[label] = builds.get(label, 0) + 1
 
     def _l0_warm(self, findex) -> None:
         """Compile the L0 program at EVERY batch tier of the index's
@@ -1487,6 +1625,12 @@ class VariantEngine:
             getattr(findex, "window_hint", eng.window_cap),
         )
         shape = (
+            # the class name is part of run_queries' program identity,
+            # so a composite and a monolithic index at the same padded
+            # dims are DIFFERENT programs — key the warm set the same
+            # way or the second one skips its warm and compiles
+            # mid-request
+            type(findex).__name__,
             findex.n_padded,
             getattr(findex, "n_shards_padded", findex.n_shards),
             win,
@@ -1523,6 +1667,24 @@ class VariantEngine:
             doc["shards"] = len(state[1])
             doc["rows"] = state[3]
             doc["ageS"] = round(time.time() - state[4], 1)
+        # per-key block detail (ISSUE 20): the bench's structural
+        # "untouched keys are not restacked" assert reads the per-key
+        # build counts; blockReuses is the complementary signal
+        blocks = self._l0_blocks
+        if blocks:
+            doc["keys"] = {
+                f"{ds}/{vcf}": {
+                    "shards": len(entries),
+                    "rows": int(getattr(b, "n_rows", 0)),
+                    "builds": self._l0_key_builds.get(
+                        f"{ds}/{vcf}", 0
+                    ),
+                }
+                for (ds, vcf), (b, entries, _t) in sorted(
+                    blocks.items()
+                )
+            }
+        doc["blockReuses"] = self.l0_block_reuses
         return doc
 
     def l0_pre_rows(self, tail_targets, spec_base, payload) -> dict:

@@ -83,6 +83,36 @@ class _SliceDiskTracker:
 SLICE_DISK = _SliceDiskTracker()
 
 
+class _NativeFallbackTracker:
+    """Process-wide count of slice scans that fell back from the native
+    codec to the pure-Python path (``ingest.native_fallbacks``). The
+    fallback is PER BLOB — one malformed slice re-parses alone, it never
+    demotes the dataset (let alone the process) off the fast path — so a
+    non-zero rate with healthy throughput is tolerable, but a rate that
+    tracks the slice rate means every scan pays a failed native attempt
+    plus the Python re-parse: the silent ~3x ingest slowdown this series
+    exists to surface."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def tick(self) -> None:
+        with self._lock:
+            self._count += 1
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def reset(self) -> None:
+        with self._lock:
+            self._count = 0
+
+
+NATIVE_FALLBACKS = _NativeFallbackTracker()
+
+
 def register_ingest_metrics(registry) -> None:
     """The ingest pipeline's process-wide series."""
     registry.gauge(
@@ -90,6 +120,45 @@ def register_ingest_metrics(registry) -> None:
         "slice-shard temp bytes currently on disk",
         fn=lambda: SLICE_DISK.stats()["current"],
     )
+    registry.counter(
+        "ingest.native_fallbacks",
+        "slice scans that fell back from the native codec to the "
+        "pure-Python path (per blob, never per dataset)",
+        fn=NATIVE_FALLBACKS.count,
+    )
+
+
+#: max size of one compressed BGZF block (BSIZE is u16): the remote
+#: fetch must cover the whole block containing the slice's end voffset
+_BLOCK_MAX = 1 << 16
+
+
+def native_slice_text(vcf_path: str | Path, vstart: int, vend: int) -> bytes:
+    """THE native decode seam: uncompressed slice text for the
+    virtual-offset range [vstart, vend), local or remote.
+
+    Local files stream through ``native.inflate_range`` (the file-path
+    entry point). Remote scan blobs fetch their compressed span by one
+    concurrent ranged GET — sockets release the GIL — and inflate it
+    in place through ``native.inflate_buffer`` (ctypes releases the GIL
+    too), so worker-count scaling moves ingest throughput instead of
+    serialising on the interpreter. Raises on any native refusal; the
+    caller owns the per-blob pure-Python fallback (and the
+    ``ingest.native_fallbacks`` tick). Every native decode call site in
+    the ingest plane routes through here (tools/check_native_seam.py)."""
+    from .. import native
+
+    if not is_remote(vcf_path):
+        return native.inflate_range(str(vcf_path), vstart, vend)
+    from ..genomics.bgzf import split_virtual_offset
+    from ..io import open_source
+
+    c0, u0 = split_virtual_offset(vstart)
+    c1, u1 = split_virtual_offset(vend)
+    src = open_source(vcf_path)
+    fetch_end = min(c1 + _BLOCK_MAX, src.size())
+    blob = src.read_range(c0, fetch_end, workers=4)
+    return native.inflate_buffer(blob, u0, ((c1 - c0) << 16) | u1)
 
 
 def read_slice_records(
@@ -106,15 +175,18 @@ def read_slice_records(
     try:
         from .. import native
 
-        if native.prefer_native_io() and not is_remote(vcf_path):
-            text = native.inflate_range(str(vcf_path), vstart, vend)
+        if native.prefer_native_io():
+            text = native_slice_text(vcf_path, vstart, vend)
             records = []
             for line in text.split(b"\n"):
                 rec = parse_record(line)
                 if rec is not None:
                     records.append(rec)
             return records
-    except Exception:  # fall back to the pure-python reader
+    except Exception:
+        # fall back to the pure-python reader, per blob; the fallback
+        # tick belongs to scan_slice_to_shard (the one scan entry), so
+        # a decode failure that re-fails here is not counted twice
         pass
     reader = BgzfReader(vcf_path)
     records = []
@@ -144,8 +216,11 @@ def scan_slice_to_shard(
 
     if native.available():
         try:
-            if native.prefer_native_io() and not is_remote(vcf_path):
-                text = native.inflate_range(str(vcf_path), vstart, vend)
+            if native.prefer_native_io():
+                # one seam for local AND remote: the remote leg streams
+                # the fetched blob through the native decoder instead of
+                # the GIL-bound pure-Python block loop
+                text = native_slice_text(vcf_path, vstart, vend)
             else:
                 text = BgzfReader(vcf_path).read_range(vstart, vend)
             return build_index_from_text(
@@ -156,6 +231,7 @@ def scan_slice_to_shard(
             )
         except ValueError:
             # deliberate refusal (e.g. AC= arity mismatch): quiet
+            NATIVE_FALLBACKS.tick()
             log.debug(
                 "fast slice scan refused for %s [%d,%d); python path",
                 vcf_path,
@@ -166,6 +242,7 @@ def scan_slice_to_shard(
         except Exception:
             # unexpected: every slice paying a failed fast attempt plus
             # the python re-parse is a silent ~3x ingest slowdown — say so
+            NATIVE_FALLBACKS.tick()
             log.warning(
                 "fast slice scan FAILED for %s [%d,%d); falling back to "
                 "the python parser",

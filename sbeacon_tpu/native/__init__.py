@@ -94,6 +94,17 @@ def get_lib():
             ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.sbn_inflate_range.restype = ctypes.c_int
+        if hasattr(lib, "sbn_inflate_buffer"):
+            lib.sbn_inflate_buffer.argtypes = [
+                ctypes.POINTER(ctypes.c_uint8),
+                ctypes.c_uint64,
+                ctypes.c_uint64,
+                ctypes.c_uint64,
+                ctypes.c_int,
+                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.sbn_inflate_buffer.restype = ctypes.c_int
         lib.sbn_compress_bgzf.argtypes = [
             ctypes.POINTER(ctypes.c_uint8),
             ctypes.c_uint64,
@@ -235,9 +246,19 @@ def available() -> bool:
 def prefer_native_io() -> bool:
     """Whether the native BGZF codec should take over I/O paths: it wins
     via block-parallel inflate, so a single-core host keeps python's
-    one-shot zlib (both are C underneath; the pool only adds overhead)."""
+    one-shot zlib (both are C underneath; the pool only adds overhead).
+    ``BEACON_NATIVE_IO=0`` is the operator kill switch — every call site
+    behind this gate has a pure-Python fallback, so flipping it degrades
+    throughput, never correctness."""
     import os
 
+    if os.environ.get("BEACON_NATIVE_IO", "").strip().lower() in (
+        "0",
+        "off",
+        "false",
+        "no",
+    ):
+        return False
     return (os.cpu_count() or 1) >= 2 and available()
 
 
@@ -280,6 +301,48 @@ def inflate_range(
     )
     if rc != 0:
         raise NativeUnavailable(f"sbn_inflate_range failed rc={rc}")
+    return _take_buffer(lib, out_p, out_len)
+
+
+def inflate_buffer(
+    data: bytes,
+    vstart: int = 0,
+    vend: int | None = None,
+    *,
+    n_threads: int | None = None,
+) -> bytes:
+    """Decompress the BGZF virtual-offset range [vstart, vend) of a
+    compressed blob already in memory — the remote scan-blob leg, where
+    the span arrives by ranged GET and never touches local disk. Offsets
+    are relative to the blob, whose first byte must be a block boundary
+    (fetch from the compressed half of the slice's start voffset). The
+    ctypes call releases the GIL, so scan workers inflate in parallel."""
+    if n_threads is None:
+        import os
+
+        n_threads = min(8, os.cpu_count() or 1)
+    lib = get_lib()
+    if lib is None:
+        raise NativeUnavailable("native library not built")
+    if not hasattr(lib, "sbn_inflate_buffer"):
+        raise NativeUnavailable("sbn_inflate_buffer missing (stale library)")
+    import numpy as np
+
+    # zero-copy in: the C side only reads the blob
+    view = np.frombuffer(data or b"\0", dtype=np.uint8)
+    out_p = ctypes.POINTER(ctypes.c_uint8)()
+    out_len = ctypes.c_uint64()
+    rc = lib.sbn_inflate_buffer(
+        view.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        len(data),
+        vstart,
+        2**64 - 1 if vend is None else vend,
+        n_threads,
+        ctypes.byref(out_p),
+        ctypes.byref(out_len),
+    )
+    if rc != 0:
+        raise NativeUnavailable(f"sbn_inflate_buffer failed rc={rc}")
     return _take_buffer(lib, out_p, out_len)
 
 

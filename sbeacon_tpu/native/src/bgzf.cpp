@@ -88,20 +88,13 @@ std::vector<uint8_t>* ReadFile(const char* path) {
   return data;
 }
 
-}  // namespace
-
-extern "C" {
-
-// Decompress the virtual-offset range [vstart, vend) of a BGZF file.
-// vend == UINT64_MAX means "to EOF". The caller owns *out (sbn_free).
-// Returns 0 on success.
-int sbn_inflate_range(const char* path, uint64_t vstart, uint64_t vend,
-                      int n_threads, uint8_t** out, uint64_t* out_len) {
-  std::vector<uint8_t>* file = ReadFile(path);
-  if (!file) return 1;
-  const uint8_t* data = file->data();
-  const size_t fsize = file->size();
-
+// Shared core of sbn_inflate_range / sbn_inflate_buffer: decompress the
+// virtual-offset range [vstart, vend) of a BGZF stream already resident
+// in memory (compressed offsets are relative to `data`, which must begin
+// at a block boundary). Same return codes as the extern entry points.
+int InflateRangeCore(const uint8_t* data, size_t fsize, uint64_t vstart,
+                     uint64_t vend, int n_threads, uint8_t** out,
+                     uint64_t* out_len) {
   uint64_t cstart = vstart >> 16;
   uint32_t ustart = uint32_t(vstart & 0xffff);
   uint64_t cend = vend >> 16;
@@ -115,10 +108,7 @@ int sbn_inflate_range(const char* path, uint64_t vstart, uint64_t vend,
     if (!to_eof && coff > cend) break;
     uint32_t bsize = BlockSize(data + coff, fsize - coff);
     if (bsize == 0 || coff + bsize > fsize) {
-      if (blocks.empty()) {
-        delete file;
-        return 2;
-      }
+      if (blocks.empty()) return 2;
       break;  // trailing garbage: stop at last good block
     }
     uint32_t isize;
@@ -133,16 +123,12 @@ int sbn_inflate_range(const char* path, uint64_t vstart, uint64_t vend,
   if (blocks.empty()) {
     *out = nullptr;
     *out_len = 0;
-    delete file;
     return 0;
   }
 
   uint64_t total = uoff;
   uint8_t* buf = static_cast<uint8_t*>(std::malloc(total ? total : 1));
-  if (!buf) {
-    delete file;
-    return 3;
-  }
+  if (!buf) return 3;
 
   std::atomic<int> failed{0};
   auto payload_of = [&](const Block& b, size_t* hdr_out) {
@@ -192,14 +178,17 @@ int sbn_inflate_range(const char* path, uint64_t vstart, uint64_t vend,
     std::unique_lock<std::mutex> lk(mu);
     cv.wait(lk, [&] { return remaining == 0; });
   }
-  delete file;
   if (failed.load()) {
     std::free(buf);
     return 4;
   }
 
-  // trim to the within-block offsets of the virtual range
+  // trim to the within-block offsets of the virtual range; a start
+  // offset past the first block's payload contributes nothing from
+  // THAT block (the reference reader slices payload[uoff:] per block
+  // — it never bleeds into the next block's bytes)
   uint64_t begin = ustart;
+  if (begin > blocks.front().isize) begin = blocks.front().isize;
   uint64_t end = total;
   if (!to_eof) {
     // find the block at cend; its uoffset + uend_within bounds the range
@@ -217,6 +206,35 @@ int sbn_inflate_range(const char* path, uint64_t vstart, uint64_t vend,
   *out = buf;
   *out_len = n;
   return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Decompress the virtual-offset range [vstart, vend) of a BGZF file.
+// vend == UINT64_MAX means "to EOF". The caller owns *out (sbn_free).
+// Returns 0 on success.
+int sbn_inflate_range(const char* path, uint64_t vstart, uint64_t vend,
+                      int n_threads, uint8_t** out, uint64_t* out_len) {
+  std::vector<uint8_t>* file = ReadFile(path);
+  if (!file) return 1;
+  int rc = InflateRangeCore(file->data(), file->size(), vstart, vend,
+                            n_threads, out, out_len);
+  delete file;
+  return rc;
+}
+
+// Decompress the virtual-offset range [vstart, vend) of a BGZF blob
+// already in memory — the remote scan-blob leg, where the compressed
+// span arrives by ranged GET. Offsets are relative to the blob (its
+// first byte must be a block boundary); vend == UINT64_MAX means "to
+// the end of the blob". The caller owns *out (sbn_free).
+int sbn_inflate_buffer(const uint8_t* data, uint64_t len, uint64_t vstart,
+                       uint64_t vend, int n_threads, uint8_t** out,
+                       uint64_t* out_len) {
+  return InflateRangeCore(data, size_t(len), vstart, vend, n_threads, out,
+                          out_len);
 }
 
 // Compress data into a full BGZF stream (64KB blocks + EOF marker).

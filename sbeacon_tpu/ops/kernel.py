@@ -354,12 +354,15 @@ class L0DeviceIndex(FusedDeviceIndex):
         super().__init__(shards, pad_unit=pad_unit)
         k = self.n_shards
         k_pad = next((t for t in self.SHARD_TIERS if k <= t), k)
+        co = np.asarray(self.arrays["chrom_offsets"])
         if k_pad != k:
-            co = np.asarray(self.arrays["chrom_offsets"])
             pad = np.zeros((k_pad - k, co.shape[1]), dtype=co.dtype)
-            self.arrays["chrom_offsets"] = jnp.asarray(
-                np.concatenate([co, pad])
-            )
+            co = np.concatenate([co, pad])
+            self.arrays["chrom_offsets"] = jnp.asarray(co)
+        #: host copy of the padded segment table: the per-key composite
+        #: (CompositeL0DeviceIndex) shifts and restacks it without a
+        #: device round-trip per rebuild
+        self.chrom_offsets_host = co
         self.n_shards_padded = k_pad
         # a tail shard's candidate window can never exceed its own
         # row count, so the launch may run with a window sized to the
@@ -380,6 +383,76 @@ class L0DeviceIndex(FusedDeviceIndex):
     #: <=8192 rows), so the extra compiled tiers cost little and the
     #: engine pre-warms them at build time.
     batch_tiers = (8, 16, 32, 64, 512, 2048)
+
+
+class CompositeL0DeviceIndex:
+    """Per-key L0 blocks assembled into ONE serving index (ISSUE 20).
+
+    The per-(dataset, vcf) L0 refactor keeps a standing
+    :class:`L0DeviceIndex` block per covered key, so a delta publish to
+    key A re-stacks (host gather + device upload) ONLY key A's block.
+    Serving still holds the single-launch contract — ``l0_pre_rows``
+    answers every covered tail row across keys with ONE coalesced
+    launch — and this class is what squares the two: the blocks'
+    device-resident row columns concatenate device-side (HBM-to-HBM, no
+    host restack of untouched keys), each block's padded ``[k, 27]``
+    segment table shifts by the block's row offset and stacks along the
+    shard axis (a pad shard's all-zero row shifts to ``[off, off)`` —
+    still empty, still unmatchable), and composite shard ids index the
+    stacked table. It exposes the same attribute surface ``run_queries``
+    reads (``arrays`` / ``n_iters`` / ``n_shards_padded`` /
+    ``window_hint`` / ``flight_family`` / ``batch_tiers`` /
+    ``to_local_rows``), so the launch path cannot tell it from a
+    monolithic stack; the class name rides the program identity, so its
+    programs never alias the monolithic index's."""
+
+    flight_family = "fused_l0"
+    batch_tiers = L0DeviceIndex.batch_tiers
+
+    def __init__(self, blocks: list[L0DeviceIndex]):
+        if not blocks:
+            raise ValueError("CompositeL0DeviceIndex needs >= 1 block")
+        parts: dict[str, list] = {}
+        co_parts: list[np.ndarray] = []
+        base_parts: list[np.ndarray] = []
+        #: composite sid of each block's shard 0 (block order preserved)
+        self.block_sid_offsets: list[int] = []
+        row_off = 0
+        sid_off = 0
+        for b in blocks:
+            self.block_sid_offsets.append(sid_off)
+            co = b.chrom_offsets_host
+            co_parts.append((co + row_off).astype(co.dtype, copy=False))
+            sb = np.asarray(b.shard_base, dtype=np.int64)
+            # pad shards (sid past the block's real count) clamp to the
+            # block's end base: they are never routed, but the base
+            # array must stay index-aligned with the stacked table
+            clamp = np.minimum(np.arange(b.n_shards_padded), b.n_shards)
+            base_parts.append(sb[clamp] + row_off)
+            for name, arr in b.arrays.items():
+                if name != "chrom_offsets":
+                    parts.setdefault(name, []).append(arr)
+            row_off += b.n_padded
+            sid_off += b.n_shards_padded
+        self.arrays = {
+            name: (vals[0] if len(vals) == 1 else jnp.concatenate(vals))
+            for name, vals in parts.items()
+        }
+        self.arrays["chrom_offsets"] = jnp.asarray(np.concatenate(co_parts))
+        self.blocks = list(blocks)
+        self.n_rows = sum(b.n_rows for b in blocks)
+        self.n_padded = row_off
+        self.n_iters = bisect_iters(row_off)
+        self.n_shards = sum(b.n_shards for b in blocks)
+        self.n_shards_padded = sid_off
+        self.shard_base = np.concatenate(
+            base_parts + [np.asarray([row_off], dtype=np.int64)]
+        )
+        self.window_hint = max(b.window_hint for b in blocks)
+
+    def to_local_rows(self, rows: np.ndarray, sid: int) -> np.ndarray:
+        """Stacked row ids (already -1-filtered) -> shard-local ids."""
+        return rows.astype(np.int64) - int(self.shard_base[sid])
 
 
 @dataclass

@@ -1965,6 +1965,11 @@ class MeshDispatchTier:
             # engines without an L0 registry: every tail shard below
             # host-walks and charges
             charge_cost(delta_shards=len(delta_targets))
+        # how much of the tail rode the device launch vs host-walked:
+        # with per-key L0 blocks (ISSUE 20) a key mid-restack simply
+        # falls out of coverage for a beat, and this split is the
+        # per-request signal that shows it
+        l0_covered = sum(1 for v in l0_rows.values() if v is not None)
         for key, shard, native, pl in delta_targets:
             rows = l0_rows.get(key)
             if rows is None:
@@ -1994,6 +1999,7 @@ class MeshDispatchTier:
         annotate(
             mesh_shards=len(targets),
             mesh_delta_tail=len(delta_targets),
+            mesh_tail_l0=l0_covered,
             mesh_planes=plane_q,
         )
         plan_stage(
@@ -2001,6 +2007,7 @@ class MeshDispatchTier:
             decision="served",
             shards=len(targets),
             delta_tail=len(delta_targets),
+            tail_l0=l0_covered,
             planes=plane_q,
         )
         return responses

@@ -735,6 +735,7 @@ ANNOTATION_KEYS = frozenset({
     "lane",
     "mesh_delta_tail",
     "mesh_fallback",
+    "mesh_tail_l0",
     "mesh_planes",
     "mesh_shards",
     "query_job",

@@ -335,7 +335,12 @@ def test_compaction_preserves_answers_and_retires_tail(tmp_path):
         assert eng.delta_stats() == {}
         assert eng.base_fingerprint() != base_fp
         assert _variants(eng.search(q)) == pre
-        assert comp.metrics()["runs"] == 1
+        # tiered is the DEFAULT (ISSUE 20): this tail is large
+        # relative to the base (80/120 rows >= the 0.35 byte ratio),
+        # so ONE sweep runs both tiers — the L1 consolidation and the
+        # ratio-triggered base merge
+        assert comp.metrics()["runs"] == 2
+        assert comp.metrics()["tier_folds"] == {"l1": 1, "base": 1}
     finally:
         eng.close()
 
@@ -845,6 +850,73 @@ def test_mesh_tier_delta_tail_rides_l0():
         eng.close()
 
 
+def test_publish_burst_on_one_key_leaves_other_keys_l0_untouched():
+    """ISSUE 20 regression: the L0 tier keeps per-(dataset, vcf)
+    stacks — a deep publish burst on key A restacks ONLY key A's
+    block. Key B's standing block (same object), its answers, and the
+    compile tracker are untouched: zero mid-request compiles on
+    either key after the burst."""
+    import sbeacon_tpu.telemetry as tel
+
+    recs_a = random_records(random.Random(70), chrom="1", n=400,
+                            n_samples=2)
+    recs_b = random_records(random.Random(71), chrom="1", n=400,
+                            n_samples=2)
+    eng = _engine(
+        _shard(recs_a[:200]),
+        _shard(recs_b[:200], ds="dsB", vcf="b.vcf"),
+        l0_min_shards=3,
+        response_cache=False,
+    )
+    try:
+        for i in range(4):
+            eng.add_delta(
+                _shard(recs_a[200 + 40 * i:240 + 40 * i], vcf="a.vcf")
+            )
+            eng.add_delta(
+                _shard(recs_b[200 + 40 * i:240 + 40 * i], ds="dsB",
+                       vcf="b.vcf")
+            )
+        status = eng.l0_status()
+        assert status["built"]
+        assert set(status["keys"]) == {"dsA/a.vcf", "dsB/b.vcf"}
+        a_builds = status["keys"]["dsA/a.vcf"]["builds"]
+        b_builds = status["keys"]["dsB/b.vcf"]["builds"]
+        b_block = eng._l0_blocks[("dsB", "b.vcf")][0]
+        # warm both keys' serving paths, then snapshot the tracker
+        pre_a = _variants(eng.search(_bracket(chrom="1",
+                                              datasets=["dsA"])))
+        pre_b = _variants(eng.search(_bracket(chrom="1",
+                                              datasets=["dsB"])))
+        assert pre_a and pre_b
+        c0 = tel.flight_recorder.mid_request_compiles()
+        # the burst: key A only
+        for i in range(6):
+            eng.add_delta(
+                _shard([_rec("1", 500_000 + i)], vcf="a.vcf")
+            )
+        status = eng.l0_status()
+        assert status["keys"]["dsA/a.vcf"]["builds"] > a_builds
+        assert status["keys"]["dsB/b.vcf"]["builds"] == b_builds, (
+            "a burst on key A restacked key B's L0 block"
+        )
+        assert eng._l0_blocks[("dsB", "b.vcf")][0] is b_block, (
+            "key B's standing block was rebuilt, not reused"
+        )
+        assert status["blockReuses"] > 0
+        # both keys still answer, and nothing compiled mid-request:
+        # every composite shape the burst created was warmed at build
+        got_a = _variants(eng.search(_bracket(chrom="1",
+                                              datasets=["dsA"])))
+        assert any("500005" in v for v in got_a)
+        assert pre_a <= got_a
+        assert _variants(eng.search(_bracket(chrom="1",
+                                             datasets=["dsB"]))) == pre_b
+        assert tel.flight_recorder.mid_request_compiles() - c0 == 0
+    finally:
+        eng.close()
+
+
 # -- size-tiered compaction + GC (ISSUE 15) -----------------------------------
 
 
@@ -867,12 +939,17 @@ def test_compactor_notify_folds_only_the_tripping_key(tmp_path):
             eng, tmp_path, delta_max_shards=2, compact_interval_s=0.0
         )
         comp.notify("dsA", "a.vcf", eng.delta_depth("dsA", "a.vcf"))
-        # dsA folded; dsB's equally deep tail MUST still stand
+        # dsA folded — under the tiered DEFAULT (ISSUE 20) its tiny
+        # tail consolidates into ONE standing L1 entry and the base
+        # merge stays deferred (3 rows vs a 100-row base is far below
+        # the byte ratio); dsB's equally deep raw tail MUST still
+        # stand untouched
         stats = eng.delta_stats()
-        assert "dsA" not in stats
+        assert stats["dsA"]["shards"] == 1
         assert stats["dsB"]["shards"] == 3, (
             "another key's trigger folded an unrelated tail"
         )
+        assert comp.metrics()["tier_folds"] == {"l1": 1}
     finally:
         eng.close()
 

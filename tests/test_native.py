@@ -185,3 +185,159 @@ def test_gt_planes_extra_genotypes_normalised():
     np.testing.assert_array_equal(a.tok_bits1, b.tok_bits1)
     # only the first 2 samples' bits are ever set
     assert int(a.gt_bits[0, 0]) & ~0b11 == 0
+
+
+# -- remote scan-blob codec (ISSUE 20) ----------------------------------------
+
+
+def _ragged_bgzf(path, text: bytes) -> None:
+    """A valid BGZF stream whose blocks are deliberately RAGGED —
+    payload sizes cycling from near-empty to the 65280 cap — the shape
+    the fixed-chunk writer never produces but real bgzip re-compression
+    of mixed-width VCF lines does."""
+    from sbeacon_tpu.genomics.bgzf import BGZF_EOF, compress_block
+
+    sizes = [37, 65280, 1, 4096, 63999, 17, 1024]
+    with open(path, "wb") as fh:
+        pos = 0
+        i = 0
+        while pos < len(text):
+            n = sizes[i % len(sizes)]
+            fh.write(compress_block(text[pos:pos + n]))
+            pos += n
+            i += 1
+        fh.write(BGZF_EOF)
+
+
+def _slice_cases(path):
+    """Virtual-offset ranges spanning block boundaries, including
+    mid-block start/end offsets and a to-EOF tail."""
+    blocks = scan_blocks(path)
+    assert len(blocks) >= 4
+    last_c, last_len, last_u = blocks[-1]
+    return [
+        (make_virtual_offset(blocks[0][0], 0),
+         make_virtual_offset(blocks[2][0], 0)),
+        (make_virtual_offset(blocks[0][0], 11),
+         make_virtual_offset(blocks[3][0], 7)),
+        (make_virtual_offset(blocks[1][0], 3),
+         make_virtual_offset(blocks[1][0], min(200, blocks[1][2]))),
+        # past-EOF end, the planner's final-slice shape
+        (make_virtual_offset(blocks[2][0], 5),
+         make_virtual_offset(last_c + (1 << 16), 0)),
+    ]
+
+
+@pytest.mark.parametrize("kind", ["multiallelic", "symbolic", "ragged"])
+def test_native_scan_codec_parity_local_and_remote(
+    tmp_path, kind
+):
+    """The native decode seam is byte-identical to the pure-Python
+    reader on multi-allelic, symbolic-alt, and ragged-block inputs —
+    for LOCAL paths (file inflate) and REMOTE urls (ranged-GET blob
+    inflate) alike."""
+    from sbeacon_tpu.ingest.pipeline import native_slice_text
+    from sbeacon_tpu.testing import random_records, range_server
+
+    rng = random.Random(80)
+    if kind == "ragged":
+        lines = [
+            b"x" * (rng.randrange(1, 400)) + b"\n" for _ in range(9000)
+        ]
+        path = tmp_path / "ragged.bin.gz"
+        _ragged_bgzf(path, b"".join(lines))
+    else:
+        recs = random_records(
+            rng, chrom="9", n=6000, n_samples=4,
+            p_multiallelic=0.7 if kind == "multiallelic" else 0.1,
+            p_symbolic=0.6 if kind == "symbolic" else 0.0,
+        )
+        path = tmp_path / f"{kind}.vcf.gz"
+        write_vcf(path, recs, sample_names=[f"S{i}" for i in range(4)])
+    reader = BgzfReader(path)
+    cases = _slice_cases(path)
+    with range_server(tmp_path) as base:
+        url = f"{base}/{path.name}"
+        for vs, ve in cases:
+            want = reader.read_range(vs, ve)
+            assert native_slice_text(path, vs, ve) == want, (kind, vs, ve)
+            assert native_slice_text(url, vs, ve) == want, (kind, vs, ve)
+
+
+def test_malformed_blob_falls_back_per_blob_not_per_dataset(
+    tmp_path, monkeypatch
+):
+    """A native refusal on ONE remote scan blob falls back to the
+    pure-Python reader for THAT blob only: the slice still ingests
+    (identical shard), the next blob rides the native codec again, and
+    ``ingest.native_fallbacks`` ticks exactly once per failing blob."""
+    import numpy as np
+
+    from sbeacon_tpu.config import IngestConfig
+    from sbeacon_tpu.genomics.tabix import ensure_index
+    from sbeacon_tpu.ingest import pipeline as pl
+    from sbeacon_tpu.ingest.planner import plan_slices
+    from sbeacon_tpu.telemetry import MetricsRegistry
+    from sbeacon_tpu.testing import random_records, range_server
+
+    samples = ["S0", "S1"]
+    recs = random_records(random.Random(81), chrom="5", n=20_000,
+                          n_samples=len(samples))
+    path = tmp_path / "cohort.vcf.gz"
+    write_vcf(path, recs, sample_names=samples)
+    idx = ensure_index(path)
+    slices = plan_slices(
+        idx,
+        IngestConfig(min_task_time=1e-9, scan_rate=1e3,
+                     dispatch_cost=1e-10, max_concurrency=1000),
+    ).slices
+    assert len(slices) >= 2
+
+    monkeypatch.setattr(native, "prefer_native_io", lambda: True)
+    real = native.inflate_buffer
+    state = {"fail": False, "native_calls": 0}
+
+    def flaky(data, vstart=0, vend=None, **kw):
+        state["native_calls"] += 1
+        if state["fail"]:
+            raise ValueError("synthetic native refusal")
+        return real(data, vstart, vend, **kw)
+
+    monkeypatch.setattr(native, "inflate_buffer", flaky)
+    reg = MetricsRegistry()
+    pl.register_ingest_metrics(reg)
+    fb0 = pl.NATIVE_FALLBACKS.count()
+
+    def scan(sl):
+        return pl.scan_slice_to_shard(
+            url, sl[0], sl[1], dataset_id="dsA",
+            sample_names=samples,
+        )
+
+    with range_server(tmp_path) as base:
+        url = f"{base}/{path.name}"
+        good0 = scan(slices[0])  # native leg, no tick
+        assert pl.NATIVE_FALLBACKS.count() == fb0
+        assert state["native_calls"] >= 1
+        state["fail"] = True  # blob 2's decode refuses
+        broken = scan(slices[1])
+        assert pl.NATIVE_FALLBACKS.count() == fb0 + 1, (
+            "a malformed blob must tick the fallback counter once"
+        )
+        state["fail"] = False  # ...and the NEXT blob is native again
+        calls_before = state["native_calls"]
+        again = scan(slices[1])
+        assert state["native_calls"] > calls_before
+        assert pl.NATIVE_FALLBACKS.count() == fb0 + 1
+    # per-blob, never per-dataset: the fallen-back blob produced a
+    # shard IDENTICAL to its native twin — same rows, same columns
+    assert good0.n_rows > 0
+    assert broken.n_rows == again.n_rows > 0
+    np.testing.assert_array_equal(
+        broken.cols["pos"], again.cols["pos"]
+    )
+    np.testing.assert_array_equal(broken.gt_bits, again.gt_bits)
+    # the registered series reads the same tracker
+    assert reg.render_json()["ingest"]["native_fallbacks"] == (
+        pl.NATIVE_FALLBACKS.count()
+    )

@@ -734,3 +734,58 @@ def test_bench_history_flags_regressions(tmp_path, capsys):
     out = capsys.readouterr().out
     assert "REGRESSION" in out and "skipped" in out
     assert bh.main(["--dir", str(tmp_path), "--strict"]) == 1
+
+
+@obs
+def test_bench_history_diffs_ingest_and_metadata_families(tmp_path, capsys):
+    """ISSUE 20 satellite: INGEST_rNN / METADATA_rNN rounds are bare
+    parsed documents (no harness wrapper) diffed within their own
+    family — never against BENCH rounds — ordered by the filename's
+    rNN ordinal."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        import bench_history as bh
+    finally:
+        sys.path.pop(0)
+    # rate keys beat the generic _s latency suffix; campaign wall
+    # clocks are latency-like
+    assert bh.direction("chroms.1.ingest_rec_per_s") == 1
+    assert bh.direction("populate.entities_per_s") == 1
+    assert bh.direction("chroms.1.ingest_seconds") == -1
+    assert bh.direction("queries.probe.p50_ms") == -1
+    assert bh.direction("chroms.1.records") == 0  # dataset size: informative
+
+    (tmp_path / "INGEST_r01.json").write_text(
+        json.dumps({"chroms": {"1": {"ingest_rec_per_s": 2000.0}}})
+    )
+    (tmp_path / "INGEST_r02.json").write_text(
+        json.dumps({"chroms": {"1": {"ingest_rec_per_s": 1000.0}}})
+    )
+    # a BENCH round in the same dir must not enter the INGEST diff
+    (tmp_path / "BENCH_r01.json").write_text(
+        json.dumps({"n": 1, "parsed": {"value": 1.0}})
+    )
+    (tmp_path / "METADATA_r09.json").write_text(
+        json.dumps({"queries": {"probe": {"p50_ms": 1.0}}})
+    )
+    (tmp_path / "METADATA_r10.json").write_text(
+        json.dumps({"queries": {"probe": {"p50_ms": 5.0}}})
+    )
+    rounds, skipped = bh.load_rounds(tmp_path, "INGEST")
+    assert [n for n, _ in rounds] == ["INGEST_r01.json", "INGEST_r02.json"]
+    assert skipped == []
+    regressions, _ = bh.diff_rounds(rounds, 0.10)
+    assert {r["key"] for r in regressions} == {"chroms.1.ingest_rec_per_s"}
+    # r09 < r10 by ordinal, not lexical luck: two-digit ordinals sort
+    rounds, _ = bh.load_rounds(tmp_path, "METADATA")
+    assert [n for n, _ in rounds] == [
+        "METADATA_r09.json",
+        "METADATA_r10.json",
+    ]
+    regressions, _ = bh.diff_rounds(rounds, 0.10)
+    assert {r["key"] for r in regressions} == {"queries.probe.p50_ms"}
+    # main() walks all three families; strict gates on any of them
+    assert bh.main(["--dir", str(tmp_path)]) == 0
+    out = capsys.readouterr().out
+    assert "INGEST: 2 rounds" in out and "METADATA: 2 rounds" in out
+    assert bh.main(["--dir", str(tmp_path), "--strict"]) == 1

@@ -190,9 +190,12 @@ GOLDEN_METRICS = [
     "ingest.delta_publishes",
     "ingest.delta_shards",
     "ingest.l0_builds",
+    "ingest.l0_key_builds",
+    "ingest.l0_block_reuses",
     "ingest.l0_served_queries",
     "ingest.slice_disk_bytes",
     "ingest.gc_bytes",
+    "ingest.native_fallbacks",
     "compaction.runs",
     "compaction.folded_rows",
     "compaction.tier_folds",
@@ -713,6 +716,80 @@ def test_launch_recording_lint_catches_violations():
         "                                record_cap=1, n_iters=1)\n",
     )
     assert len(errs) == 1 and "_query_batch_donated" in errs[0]
+
+
+# -- native decode seam lint (ISSUE 20 satellite) ------------------------------
+
+
+@obs
+def test_native_seam_lint():
+    """The ingest plane keeps ONE native decode seam (native_slice_text
+    routing inflate_range locally and inflate_buffer remotely), and
+    every caller keeps its per-blob pure-Python fallback guard."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "check_native_seam.py")],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+@obs
+def test_native_seam_lint_catches_violations():
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from check_native_seam import lint
+    finally:
+        sys.path.pop(0)
+
+    clean = {
+        "seam_defined": True,
+        "seam_entries": {"inflate_range", "inflate_buffer"},
+        "decode_calls": [
+            ("inflate_range", "ingest/pipeline.py:10", "native_slice_text", False),
+            ("inflate_buffer", "ingest/pipeline.py:20", "native_slice_text", False),
+            # the reference reader's guarded local fast path is allowed
+            ("inflate_range", "genomics/bgzf.py:30", "read_range", True),
+        ],
+        "seam_calls": [("ingest/pipeline.py:40", True)],
+    }
+    assert lint(clean) == []
+
+    # a stray remote-leg call outside the seam must fail even guarded
+    stray = dict(clean)
+    stray["decode_calls"] = clean["decode_calls"] + [
+        ("inflate_buffer", "engine.py:5", "serve", True)
+    ]
+    errs = lint(stray)
+    assert len(errs) == 1 and "inflate_buffer" in errs[0]
+
+    # an unguarded reader fast path must fail (it IS the fallback plane)
+    bare = dict(clean)
+    bare["decode_calls"] = [
+        c for c in clean["decode_calls"] if not c[1].startswith("genomics")
+    ] + [("inflate_range", "genomics/bgzf.py:30", "read_range", False)]
+    errs = lint(bare)
+    assert len(errs) == 1 and "try/except" in errs[0]
+
+    # a seam that dropped the remote leg must fail
+    local_only = dict(clean)
+    local_only["seam_entries"] = {"inflate_range"}
+    errs = lint(local_only)
+    assert len(errs) == 1 and "inflate_buffer" in errs[0]
+
+    # an unguarded seam caller must fail
+    unguarded = dict(clean)
+    unguarded["seam_calls"] = [("ingest/pipeline.py:40", False)]
+    errs = lint(unguarded)
+    assert len(errs) == 1 and "fallback" in errs[0]
+
+    # empty scans are errors, not passes
+    dead = dict(clean)
+    dead["decode_calls"] = []
+    dead["seam_entries"] = set()
+    assert len(lint(dead)) >= 2
 
 
 @obs

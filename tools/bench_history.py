@@ -11,6 +11,12 @@ direction:
 - rounds are ordered by their ``n`` field (filename as tie-break);
   rounds whose ``parsed`` is null (the harness truncated the tail
   mid-string) are listed as skipped, never a crash,
+- the ingest campaign's ``INGEST_rNN.json`` (per-chromosome scan/build
+  stats) and the metadata plane's ``METADATA_rNN.json`` (populate +
+  per-probe latencies) are bare parsed documents with no harness
+  wrapper; they are diffed as their own families — ordered by the
+  ``rNN`` in the filename, never compared across families (ISSUE 20
+  satellite),
 - the parsed document flattens to dotted numeric keys: top-level
   scalars (``value``, ``xla_qps``) and one level of config sub-dicts
   (``config3_bracket_chr1_22.qps``),
@@ -31,17 +37,25 @@ from __future__ import annotations
 
 import argparse
 import json
+import re
 import sys
 from pathlib import Path
 
 REPO = Path(__file__).resolve().parent.parent
 
-#: suffixes whose DROP is a regression (throughput-like)
+#: round families on disk: the roofline harness wrapper plus the
+#: ingest / metadata campaigns' bare parsed documents
+FAMILIES = ("BENCH", "INGEST", "METADATA")
+
+#: suffixes whose DROP is a regression (throughput-like); rate keys
+#: (``ingest_rec_per_s``, ``entities_per_s``) must match BEFORE the
+#: generic ``_s`` latency suffix below
 HIGHER_IS_BETTER = (
     "qps",
     "value",
     "vs_baseline",
     "gb_per_s",
+    "per_s",
     "queries",
 )
 #: suffixes whose RISE is a regression (latency-/time-like)
@@ -51,6 +65,7 @@ LOWER_IS_BETTER = (
     "p50_ms",
     "p99_ms",
     "ms_per_batch",
+    "seconds",
 )
 
 
@@ -87,27 +102,41 @@ def flatten(parsed: dict, prefix: str = "", depth: int = 3) -> dict[str, float]:
     return out
 
 
-def load_rounds(bench_dir: Path) -> tuple[list[tuple[str, dict]], list[str]]:
+def _round_number(name: str) -> int:
+    """The ``rNN`` ordinal embedded in a round filename
+    (``INGEST_r04.json`` -> 4); files without one sort last."""
+    m = re.search(r"_r(\d+)", name)
+    return int(m.group(1)) if m else 1 << 30
+
+
+def load_rounds(
+    bench_dir: Path, family: str = "BENCH"
+) -> tuple[list[tuple[str, dict]], list[str]]:
     """([(name, parsed)] in round order, [skipped names]) over every
-    ``BENCH_*.json`` under ``bench_dir``."""
+    ``{family}_*.json`` under ``bench_dir``. BENCH rounds may carry the
+    harness wrapper ({n, cmd, rc, tail, parsed}); INGEST / METADATA
+    rounds are bare parsed documents ordered by the filename's rNN."""
     rounds: list[tuple[int, str, dict]] = []
     skipped: list[str] = []
-    for path in sorted(bench_dir.glob("BENCH_*.json")):
+    for path in sorted(bench_dir.glob(f"{family}_*.json")):
         try:
             doc = json.loads(path.read_text())
         except (OSError, ValueError):
             skipped.append(path.name)
             continue
-        # two shapes exist: the harness wrapper {n, cmd, rc, tail,
-        # parsed} and a bare parsed document (BENCH_r05_builder.json)
-        parsed = doc.get("parsed", doc if "metric" in doc else None)
+        if family == "BENCH":
+            # two shapes exist: the harness wrapper {n, cmd, rc, tail,
+            # parsed} and a bare parsed document (BENCH_r05_builder.json)
+            parsed = doc.get("parsed", doc if "metric" in doc else None)
+            n = doc.get("n")
+            order = n if isinstance(n, int) else 1 << 30
+        else:
+            parsed = doc if isinstance(doc, dict) else None
+            order = _round_number(path.name)
         if not isinstance(parsed, dict):
             skipped.append(path.name)
             continue
-        n = doc.get("n")
-        rounds.append(
-            (n if isinstance(n, int) else 1 << 30, path.name, parsed)
-        )
+        rounds.append((order, path.name, parsed))
     rounds.sort(key=lambda r: (r[0], r[1]))
     return [(name, parsed) for _n, name, parsed in rounds], skipped
 
@@ -162,25 +191,34 @@ def main(argv=None) -> int:
         help="exit 1 when a regression beyond the threshold was found",
     )
     args = ap.parse_args(argv)
-    rounds, skipped = load_rounds(args.dir)
-    for name in skipped:
-        print(f"skipped (unparseable): {name}")
-    if len(rounds) < 2:
-        print(f"{len(rounds)} parseable round(s): nothing to diff")
-        return 0
-    regressions, changes = diff_rounds(rounds, args.threshold)
-    for rec in changes:
-        mark = "REGRESSION" if rec in regressions else "change"
+    total_regressions = 0
+    for family in FAMILIES:
+        rounds, skipped = load_rounds(args.dir, family)
+        for name in skipped:
+            print(f"skipped (unparseable): {name}")
+        if not rounds and not skipped:
+            continue  # family absent from this checkout
+        if len(rounds) < 2:
+            print(
+                f"{family}: {len(rounds)} parseable round(s): "
+                "nothing to diff"
+            )
+            continue
+        regressions, changes = diff_rounds(rounds, args.threshold)
+        for rec in changes:
+            mark = "REGRESSION" if rec in regressions else "change"
+            print(
+                f"{mark}: {rec['key']} {rec['before']:g} -> "
+                f"{rec['after']:g} ({rec['deltaPct']:+.1f}%) "
+                f"[{rec['from']} -> {rec['to']}]"
+            )
         print(
-            f"{mark}: {rec['key']} {rec['before']:g} -> "
-            f"{rec['after']:g} ({rec['deltaPct']:+.1f}%) "
-            f"[{rec['from']} -> {rec['to']}]"
+            f"{family}: {len(rounds)} rounds, {len(changes)} moves "
+            f"beyond {args.threshold:.0%}, "
+            f"{len(regressions)} regression(s)"
         )
-    print(
-        f"{len(rounds)} rounds, {len(changes)} moves beyond "
-        f"{args.threshold:.0%}, {len(regressions)} regression(s)"
-    )
-    if regressions and args.strict:
+        total_regressions += len(regressions)
+    if total_regressions and args.strict:
         return 1
     return 0
 

@@ -1,0 +1,201 @@
+#!/usr/bin/env python3
+"""Lint the native decode seam (ISSUE 20).
+
+The ingest plane promises exactly ONE entry into the native BGZF
+decoder: ``native_slice_text`` in ``sbeacon_tpu/ingest/pipeline.py``,
+which owns both the local leg (``native.inflate_range``) and the
+remote scan-blob leg (``native.inflate_buffer``). Everything above the
+seam must keep a guarded pure-Python fallback so a single malformed
+blob degrades that blob, never the dataset.
+
+Checks (AST-based, two-way):
+
+  1. ``inflate_buffer`` (the remote scan-blob leg) is called ONLY from
+     the seam — scattered call sites are how the pre-ISSUE-20 remote
+     path ended up on the GIL-bound pure-Python block loop.
+     ``inflate_range`` may additionally appear inside the reference
+     reader (``genomics/bgzf.py``) as a guarded opportunistic local
+     fast path, because that reader IS the pure-Python fallback plane.
+  2. The seam itself routes BOTH legs: it must call ``inflate_range``
+     and ``inflate_buffer``.
+  3. Every caller of ``native_slice_text`` sits inside a try/except
+     (the per-blob fallback + ``ingest.native_fallbacks`` tick live
+     with the caller, per the seam's contract).
+  4. Empty-scan guards: finding zero decode calls or zero seam callers
+     means the seam moved or the lint is scanning the wrong tree —
+     that is an error, not a pass.
+
+Run from the repo root:  python tools/check_native_seam.py
+"""
+from __future__ import annotations
+
+import ast
+import sys
+from pathlib import Path
+
+PKG = Path(__file__).resolve().parent.parent / "sbeacon_tpu"
+
+#: the one module / function allowed to touch the decoder directly
+SEAM_MODULE = "ingest/pipeline.py"
+SEAM_FUNC = "native_slice_text"
+
+#: ctypes decode entry points wrapped by sbeacon_tpu.native
+DECODE_ENTRY = ("inflate_range", "inflate_buffer")
+
+#: the reference reader may call inflate_range (never inflate_buffer)
+#: under a try/except — it is itself the pure-Python fallback plane
+READER_MODULE = "genomics/bgzf.py"
+
+
+class _SeamVisitor(ast.NodeVisitor):
+    """Collect decode calls, seam callers, and the seam definition."""
+
+    def __init__(self, relpath: str) -> None:
+        self.relpath = relpath
+        self._funcs: list[str] = []
+        self._guard_depth = 0
+        # [(entry_name, "file:line", enclosing_func_or_None, guarded)]
+        self.decode_calls: list[tuple[str, str, str | None, bool]] = []
+        # [("file:line", guarded)]
+        self.seam_calls: list[tuple[str, bool]] = []
+        self.seam_defined = False
+        self.seam_entries: set[str] = set()
+
+    # -- scope / guard tracking ------------------------------------------
+    def _visit_func(self, node) -> None:
+        self._funcs.append(node.name)
+        self.generic_visit(node)
+        self._funcs.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Try(self, node: ast.Try) -> None:
+        # only a try WITH handlers is a fallback guard; calls inside the
+        # handlers/else/finally are not covered by this try
+        if node.handlers:
+            self._guard_depth += 1
+            for n in node.body:
+                self.visit(n)
+            self._guard_depth -= 1
+            for n in node.handlers + node.orelse + node.finalbody:
+                self.visit(n)
+        else:
+            self.generic_visit(node)
+
+    # -- call sites ------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        func = node.func
+        name = None
+        if isinstance(func, ast.Attribute):
+            name = func.attr
+        elif isinstance(func, ast.Name):
+            name = func.id
+        loc = f"{self.relpath}:{node.lineno}"
+        enclosing = self._funcs[-1] if self._funcs else None
+        if name in DECODE_ENTRY:
+            self.decode_calls.append(
+                (name, loc, enclosing, self._guard_depth > 0)
+            )
+            if self.relpath == SEAM_MODULE and SEAM_FUNC in self._funcs:
+                self.seam_entries.add(name)
+        elif name == SEAM_FUNC:
+            self.seam_calls.append((loc, self._guard_depth > 0))
+        self.generic_visit(node)
+
+
+def scan(root: Path = PKG) -> dict:
+    """Walk the package (the native wrapper itself is exempt) and return
+    {"decode_calls": [...], "seam_calls": [...], "seam_defined": bool,
+    "seam_entries": set()}."""
+    out = {
+        "decode_calls": [],
+        "seam_calls": [],
+        "seam_defined": False,
+        "seam_entries": set(),
+    }
+    for path in sorted(root.rglob("*.py")):
+        rel = path.relative_to(root).as_posix()
+        if rel.startswith("native/"):
+            continue  # the ctypes wrapper package IS the decoder
+        tree = ast.parse(path.read_text(), filename=rel)
+        v = _SeamVisitor(rel)
+        v.visit(tree)
+        out["decode_calls"].extend(v.decode_calls)
+        out["seam_calls"].extend(v.seam_calls)
+        out["seam_entries"] |= v.seam_entries
+        if rel == SEAM_MODULE:
+            out["seam_defined"] = any(
+                isinstance(n, ast.FunctionDef) and n.name == SEAM_FUNC
+                for n in tree.body
+            )
+    return out
+
+
+def lint(scanned: dict) -> list[str]:
+    """Return a list of human-readable problems (empty == clean)."""
+    errors: list[str] = []
+
+    if not scanned["seam_defined"]:
+        errors.append(
+            f"{SEAM_MODULE}: seam function {SEAM_FUNC}() not found — "
+            "the native decode seam moved without updating this lint"
+        )
+    for entry in DECODE_ENTRY:
+        if scanned["seam_defined"] and entry not in scanned["seam_entries"]:
+            errors.append(
+                f"{SEAM_MODULE}: {SEAM_FUNC}() no longer calls {entry} — "
+                "the seam must route the local AND remote decode legs"
+            )
+
+    if not scanned["decode_calls"]:
+        errors.append(
+            "no native decode calls found anywhere — scan is looking at "
+            "the wrong tree or the entry points were renamed"
+        )
+    for entry, loc, enclosing, guarded in scanned["decode_calls"]:
+        in_seam = (
+            loc.startswith(SEAM_MODULE + ":") and enclosing == SEAM_FUNC
+        )
+        if in_seam:
+            continue
+        if entry == "inflate_range" and loc.startswith(
+            READER_MODULE + ":"
+        ):
+            if not guarded:
+                errors.append(
+                    f"{loc}: reference-reader inflate_range() without a "
+                    "try/except — the reader must stay its own fallback"
+                )
+            continue
+        errors.append(
+            f"{loc}: direct {entry}() call outside {SEAM_FUNC}() — "
+            "route native decodes through the one pipeline seam"
+        )
+
+    if not scanned["seam_calls"]:
+        errors.append(
+            f"no callers of {SEAM_FUNC}() found — the seam is dead code "
+            "or the scan missed the ingest plane"
+        )
+    for loc, guarded in scanned["seam_calls"]:
+        if not guarded:
+            errors.append(
+                f"{loc}: {SEAM_FUNC}() called without a try/except — "
+                "callers own the per-blob pure-Python fallback"
+            )
+    return errors
+
+
+def main() -> int:
+    errors = lint(scan())
+    if errors:
+        for e in errors:
+            print(f"ERROR: {e}")
+        return 1
+    print("native seam lint: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
